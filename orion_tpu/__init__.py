@@ -1,0 +1,11 @@
+"""orion-tpu: TPU-native asynchronous black-box / hyperparameter optimization.
+
+A ground-up JAX/XLA design with the capability surface of Oríon (reference
+mounted at /root/reference): search-space DSL, pluggable algorithms, an
+asynchronous producer/consumer worker loop over shared storage with atomic
+reservation + heartbeats, parallel "lie" strategies, experiment version
+control, and a full CLI — with the optimizer core (sampling, GP posterior,
+acquisitions) running as jitted, batched device code.
+"""
+
+__version__ = "0.1.0"

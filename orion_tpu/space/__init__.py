@@ -1,0 +1,31 @@
+"""Search-space layer: dimensions, Space container, prior DSL.
+
+Reference parity: `src/orion/algo/space.py`, `src/orion/core/io/space_builder.py`,
+`src/orion/core/worker/transformer.py` (the flat codec subsumes the transformer
+pipeline — see `orion_tpu/space/space.py`).
+"""
+
+from orion_tpu.space.dims import (
+    Categorical,
+    Dimension,
+    Fidelity,
+    Integer,
+    NotSet,
+    Real,
+)
+from orion_tpu.space.dsl import DSLError, build_dimension, build_space, split_marker
+from orion_tpu.space.space import Space
+
+__all__ = [
+    "Categorical",
+    "Dimension",
+    "DSLError",
+    "Fidelity",
+    "Integer",
+    "NotSet",
+    "Real",
+    "Space",
+    "build_dimension",
+    "build_space",
+    "split_marker",
+]

@@ -1,0 +1,198 @@
+"""The Space container and its device-flat codec.
+
+Capability parity: reference ``Space`` (`src/orion/algo/space.py:732-858`) —
+name-sorted dict of dimensions with sample/interval/contains — fused with the
+reference's transformer pipeline (`src/orion/core/worker/transformer.py`):
+instead of per-point python transform objects, the space exposes one
+shape-static codec between structured params and a flat ``(n, D)`` unit-cube
+array, which is what jitted algorithms operate on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.space.dims import Categorical, Dimension, Fidelity, NotSet
+
+
+class Space:
+    """Ordered (name-sorted) collection of dimensions."""
+
+    def __init__(self, dims=()):
+        self._dims = {}
+        for dim in dims:
+            self.register(dim)
+
+    # --- container protocol ----------------------------------------------
+    def register(self, dim):
+        if not isinstance(dim, Dimension):
+            raise TypeError(f"Expected Dimension, got {type(dim)}")
+        if dim.name in self._dims:
+            raise ValueError(f"Duplicate dimension name {dim.name!r}")
+        self._dims[dim.name] = dim
+        self._dims = dict(sorted(self._dims.items()))
+
+    def __iter__(self):
+        return iter(self._dims.values())
+
+    def __len__(self):
+        return len(self._dims)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            return list(self._dims.values())[key]
+        return self._dims[key]
+
+    def __contains__(self, key):
+        if isinstance(key, str):
+            return key in self._dims
+        return self.contains_point(key)
+
+    def keys(self):
+        return list(self._dims.keys())
+
+    def values(self):
+        return list(self._dims.values())
+
+    def items(self):
+        return list(self._dims.items())
+
+    # --- semantics --------------------------------------------------------
+    @property
+    def fidelity(self):
+        """The fidelity dimension if any (at most one is supported)."""
+        for dim in self:
+            if isinstance(dim, Fidelity):
+                return dim
+        return None
+
+    @property
+    def opt_dims(self):
+        """Dimensions that algorithms actually optimize (fidelity excluded)."""
+        return [d for d in self if not isinstance(d, Fidelity)]
+
+    @property
+    def n_cols(self):
+        """Total flat unit-cube columns."""
+        return sum(d.n_cols for d in self)
+
+    def interval(self):
+        return [d.interval() for d in self.opt_dims]
+
+    def contains_point(self, params):
+        """Host membership test of a params dict (fidelity included if present)."""
+        if set(params) != set(self._dims):
+            return False
+        return all(params[name] in dim for name, dim in self._dims.items())
+
+    def cast(self, params):
+        return {name: self._dims[name].cast(value) for name, value in params.items()}
+
+    def defaults(self):
+        return {
+            d.name: d.default_value for d in self if d.default_value is not NotSet
+        }
+
+    def configuration(self):
+        """Prior-string form — the identity used by EVC comparisons."""
+        return {d.name: d.get_prior_string() for d in self}
+
+    def __repr__(self):
+        inner = ", ".join(d.get_string() for d in self)
+        return f"Space([{inner}])"
+
+    def __eq__(self, other):
+        return isinstance(other, Space) and self.configuration() == other.configuration()
+
+    # --- device codec ------------------------------------------------------
+    def _col_slices(self):
+        out, start = {}, 0
+        for dim in self:
+            out[dim.name] = (start, start + dim.n_cols)
+            start += dim.n_cols
+        return out
+
+    def decode_flat(self, u):
+        """(n, D) unit cube -> dict of per-dim device arrays (pure jnp).
+
+        Categorical values are integer indices; fidelity dims are absent.
+        """
+        slices = self._col_slices()
+        out = {}
+        for dim in self:
+            if dim.n_cols == 0:
+                continue
+            a, b = slices[dim.name]
+            vals = dim.decode(u[:, a:b])
+            if dim.shape:
+                vals = vals.reshape((u.shape[0],) + dim.shape)
+            else:
+                vals = vals[:, 0]
+            out[dim.name] = vals
+        return out
+
+    def encode_flat(self, arrays):
+        """Inverse of :meth:`decode_flat`: dict of arrays -> (n, D) cube."""
+        cols = []
+        n = None
+        for dim in self:
+            if dim.n_cols == 0:
+                continue
+            vals = jnp.asarray(arrays[dim.name])
+            n = vals.shape[0]
+            cols.append(dim.encode(vals.reshape(n, dim.size)))
+        if not cols:
+            return jnp.zeros((0, 0))
+        return jnp.concatenate(cols, axis=1)
+
+    def sample_flat(self, key, n):
+        """Prior sampling = uniform cube (encode is each prior's CDF)."""
+        return jax.random.uniform(key, (n, self.n_cols))
+
+    # --- host <-> device boundary ------------------------------------------
+    def arrays_to_params(self, arrays, fidelity_value=None):
+        """Device arrays -> list of structured param dicts (host).
+
+        Categorical indices become category objects; a fidelity value (or the
+        dim's high) is attached when the space has a fidelity dimension.
+        """
+        host = {k: np.asarray(v) for k, v in arrays.items()}
+        n = next(iter(host.values())).shape[0] if host else 0
+        out = []
+        for i in range(n):
+            params = {}
+            for dim in self:
+                if isinstance(dim, Fidelity):
+                    params[dim.name] = int(
+                        fidelity_value if fidelity_value is not None else dim.high
+                    )
+                    continue
+                val = host[dim.name][i]
+                if isinstance(dim, Categorical):
+                    params[dim.name] = dim.from_index(val)
+                else:
+                    params[dim.name] = dim.cast(val)
+            out.append(params)
+        return out
+
+    def params_to_arrays(self, params_list):
+        """List of structured param dicts -> dict of device-ready arrays."""
+        out = {}
+        for dim in self:
+            if isinstance(dim, Fidelity):
+                continue
+            if isinstance(dim, Categorical):
+                vals = np.asarray([dim.to_index(p[dim.name]) for p in params_list])
+            else:
+                vals = np.asarray([p[dim.name] for p in params_list], dtype=float)
+            out[dim.name] = jnp.asarray(vals)
+        return out
+
+    def sample(self, key_or_seed, n=1, fidelity_value=None):
+        """End-to-end prior sampling returning structured params (host list)."""
+        if isinstance(key_or_seed, int):
+            key = jax.random.PRNGKey(key_or_seed)
+        else:
+            key = key_or_seed
+        u = self.sample_flat(key, n)
+        return self.arrays_to_params(self.decode_flat(u), fidelity_value=fidelity_value)

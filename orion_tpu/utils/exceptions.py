@@ -1,0 +1,50 @@
+"""Framework-wide exception types.
+
+Capability parity: reference `src/orion/core/utils/exceptions.py` plus DB error
+types from `src/orion/core/io/database/__init__.py` (DuplicateKeyError,
+DatabaseError) — unified here since our storage layer is one subsystem.
+"""
+
+
+class OrionTPUError(Exception):
+    """Base class for all framework errors."""
+
+
+class NoConfigurationError(OrionTPUError):
+    """Raised when an experiment configuration cannot be found."""
+
+
+class CheckError(OrionTPUError):
+    """Raised when a staged database check fails."""
+
+
+class RaceCondition(OrionTPUError):
+    """Raised when a concurrent writer won a create/update race.
+
+    Callers are expected to re-fetch state and retry once (reference semantics:
+    `experiment_builder.py:239-251`).
+    """
+
+
+class DatabaseError(OrionTPUError):
+    """Generic storage-backend failure."""
+
+
+class DuplicateKeyError(DatabaseError):
+    """A unique-index constraint was violated on insert/update."""
+
+
+class FailedUpdate(DatabaseError):
+    """A compare-and-swap update matched no document."""
+
+
+class ExecutionError(OrionTPUError):
+    """User trial script exited with a nonzero return code."""
+
+
+class BrokenExperiment(OrionTPUError):
+    """Too many broken trials; experiment aborted."""
+
+
+class InvalidResult(OrionTPUError):
+    """User script reported malformed results."""

@@ -1,0 +1,31 @@
+"""Test harness configuration.
+
+All tests run on a virtual 8-device CPU mesh so multi-chip sharding logic
+(`orion_tpu.parallel`) is exercised hermetically without TPU hardware.  The env
+vars must be set before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng_seed():
+    """Pin numpy global RNG for legacy-style deterministic tests."""
+    np.random.seed(42)
+    return 42
+
+
+@pytest.fixture
+def tmp_storage(tmp_path):
+    """A fresh file-locked storage instance in a temp dir."""
+    from orion_tpu.storage import create_storage
+
+    return create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})

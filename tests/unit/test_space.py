@@ -1,0 +1,228 @@
+"""Space layer tests: DSL grammar, codec round-trips, prior-correct sampling.
+
+Mirrors the coverage intent of reference tests/unittests/algo/test_space.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.space import (
+    Categorical,
+    DSLError,
+    Fidelity,
+    Integer,
+    Real,
+    Space,
+    build_dimension,
+    build_space,
+    split_marker,
+)
+
+
+class TestDSL:
+    def test_uniform(self):
+        dim = build_dimension("x", "uniform(-3, 5)")
+        assert isinstance(dim, Real)
+        assert dim.interval() == (-3.0, 5.0)
+        assert dim.get_prior_string() == "uniform(-3, 5)"
+
+    def test_uniform_discrete(self):
+        dim = build_dimension("x", "uniform(1, 10, discrete=True)")
+        assert isinstance(dim, Integer)
+        assert dim.interval() == (1, 10)
+
+    def test_loguniform(self):
+        dim = build_dimension("lr", "loguniform(1e-5, 1e-1)")
+        assert dim.dist == "loguniform"
+
+    def test_gaussian_alias(self):
+        dim = build_dimension("x", "gaussian(0, 2)")
+        assert dim.dist == "normal" and dim.scale == 2.0
+
+    def test_choices_list(self):
+        dim = build_dimension("opt", "choices(['adam', 'sgd', 'rmsprop'])")
+        assert isinstance(dim, Categorical)
+        assert dim.categories == ("adam", "sgd", "rmsprop")
+        assert dim.probs == pytest.approx((1 / 3,) * 3)
+
+    def test_choices_probs(self):
+        dim = build_dimension("opt", "choices({'a': 0.2, 'b': 0.8})")
+        assert dim.probs == (0.2, 0.8)
+
+    def test_choices_mixed_types(self):
+        dim = build_dimension("x", "choices([1, 'two', 3.0])")
+        assert dim.categories == (1, "two", 3.0)
+
+    def test_fidelity(self):
+        dim = build_dimension("epochs", "fidelity(1, 16, 4)")
+        assert isinstance(dim, Fidelity)
+        assert dim.budgets() == [1, 4, 16]
+
+    def test_shape_and_default(self):
+        dim = build_dimension("w", "uniform(0, 1, shape=3, default_value=0.5)")
+        assert dim.shape == (3,)
+        assert dim.default_value == 0.5
+
+    def test_no_eval(self):
+        with pytest.raises(DSLError):
+            build_dimension("x", "__import__('os').system('true')")
+        with pytest.raises(DSLError):
+            build_dimension("x", "uniform(1, open('/etc/passwd'))")
+
+    def test_bad_bounds(self):
+        with pytest.raises(DSLError):
+            build_dimension("x", "uniform(5, -3)")
+        with pytest.raises(DSLError):
+            build_dimension("x", "loguniform(-1, 1)")
+
+    def test_markers(self):
+        assert split_marker("+uniform(0, 1)") == ("+", "uniform(0, 1)")
+        assert split_marker("-uniform(0, 1)") == ("-", "uniform(0, 1)")
+        assert split_marker("uniform(0, 1)") == ("", "uniform(0, 1)")
+
+    def test_build_space(self):
+        space = build_space({"x": "uniform(0, 1)", "a": "choices(['p', 'q'])"})
+        assert space.keys() == ["a", "x"]  # name-sorted
+
+
+class TestCodec:
+    def test_uniform_roundtrip(self):
+        dim = build_dimension("x", "uniform(-3, 5)")
+        u = jnp.linspace(0.01, 0.99, 50).reshape(-1, 1)
+        x = dim.decode(u)
+        u2 = dim.encode(x)
+        np.testing.assert_allclose(np.asarray(u2), np.asarray(u), atol=1e-5)
+
+    def test_loguniform_roundtrip(self):
+        dim = build_dimension("x", "loguniform(1e-4, 1)")
+        u = jnp.linspace(0.01, 0.99, 50).reshape(-1, 1)
+        x = dim.decode(u)
+        assert float(x.min()) >= 1e-4 and float(x.max()) <= 1.0
+        np.testing.assert_allclose(np.asarray(dim.encode(x)), np.asarray(u), atol=1e-4)
+
+    def test_normal_decode_matches_quantiles(self):
+        dim = build_dimension("x", "normal(10, 2)")
+        x = dim.decode(jnp.asarray([[0.5]]))
+        assert float(x[0, 0]) == pytest.approx(10.0, abs=1e-4)
+
+    def test_truncated_normal_bounds(self):
+        dim = build_dimension("x", "normal(0, 5, low=-1, high=1)")
+        key = jax.random.PRNGKey(0)
+        u = jax.random.uniform(key, (1000, 1))
+        x = np.asarray(dim.decode(u))
+        assert x.min() >= -1 and x.max() <= 1
+
+    def test_integer_decode_inclusive_range(self):
+        dim = build_dimension("n", "uniform(1, 4, discrete=True)")
+        u = jnp.linspace(0.001, 0.999, 400).reshape(-1, 1)
+        vals = np.unique(np.asarray(dim.decode(u)))
+        assert list(vals) == [1, 2, 3, 4]
+
+    def test_integer_roundtrip(self):
+        dim = build_dimension("n", "uniform(0, 9, discrete=True)")
+        x = jnp.arange(10).reshape(-1, 1)
+        x2 = dim.decode(dim.encode(x))
+        np.testing.assert_array_equal(np.asarray(x2), np.asarray(x))
+
+    def test_categorical_prior_frequencies(self):
+        dim = build_dimension("c", "choices({'a': 0.1, 'b': 0.9})")
+        key = jax.random.PRNGKey(3)
+        u = jax.random.uniform(key, (4000, 1))
+        idx = np.asarray(dim.decode(u))
+        frac_b = (idx == 1).mean()
+        assert 0.85 < frac_b < 0.95
+
+    def test_categorical_roundtrip(self):
+        dim = build_dimension("c", "choices(['a', 'b', 'c'])")
+        idx = jnp.asarray([0, 1, 2])
+        idx2 = dim.decode(dim.encode(idx).reshape(-1, 1))
+        np.testing.assert_array_equal(np.asarray(idx2)[:, 0], np.asarray(idx))
+
+
+class TestSpace:
+    def make(self):
+        return build_space(
+            {
+                "lr": "loguniform(1e-5, 1e-1)",
+                "units": "uniform(16, 256, discrete=True)",
+                "opt": "choices(['adam', 'sgd'])",
+                "epochs": "fidelity(1, 32, 2)",
+            }
+        )
+
+    def test_n_cols_excludes_fidelity(self):
+        assert self.make().n_cols == 3
+
+    def test_sample_structured(self):
+        space = self.make()
+        params = space.sample(42, n=5)
+        assert len(params) == 5
+        for p in params:
+            assert space.contains_point(p)
+            assert p["epochs"] == 32  # fidelity defaults to max budget
+            assert p["opt"] in ("adam", "sgd")
+            assert isinstance(p["units"], int)
+
+    def test_sample_with_fidelity_value(self):
+        params = self.make().sample(0, n=2, fidelity_value=4)
+        assert all(p["epochs"] == 4 for p in params)
+
+    def test_params_arrays_roundtrip(self):
+        space = self.make()
+        params = space.sample(7, n=8)
+        arrays = space.params_to_arrays(params)
+        back = space.arrays_to_params(arrays)
+        for p, q in zip(params, back):
+            assert p["opt"] == q["opt"]
+            assert p["units"] == q["units"]
+            assert p["lr"] == pytest.approx(q["lr"], rel=1e-4)
+
+    def test_flat_roundtrip_through_cube(self):
+        space = self.make()
+        key = jax.random.PRNGKey(1)
+        u = space.sample_flat(key, 16)
+        arrays = space.decode_flat(u)
+        u2 = space.encode_flat(arrays)
+        arrays2 = space.decode_flat(u2)
+        for name in arrays:
+            np.testing.assert_allclose(
+                np.asarray(arrays[name], dtype=float),
+                np.asarray(arrays2[name], dtype=float),
+                rtol=1e-4,
+                atol=1e-5,
+            )
+
+    def test_decode_is_jittable(self):
+        space = self.make()
+
+        @jax.jit
+        def sample_decoded(key):
+            u = space.sample_flat(key, 4)
+            return space.decode_flat(u)
+
+        out = sample_decoded(jax.random.PRNGKey(0))
+        assert set(out) == {"lr", "units", "opt"}
+
+    def test_contains_rejects(self):
+        space = self.make()
+        p = space.sample(0, n=1)[0]
+        p["lr"] = 100.0
+        assert not space.contains_point(p)
+
+    def test_shaped_dim(self):
+        space = build_space({"w": "uniform(0, 1, shape=3)"})
+        assert space.n_cols == 3
+        params = space.sample(0, n=2)
+        assert np.asarray(params[0]["w"]).shape == (3,)
+
+    def test_eq_by_prior_strings(self):
+        assert self.make() == self.make()
+        other = build_space({"lr": "loguniform(1e-5, 1e-1)"})
+        assert self.make() != other
+
+    def test_getitem(self):
+        space = self.make()
+        assert space["lr"].name == "lr"
+        assert space[0].name == "epochs"  # name-sorted
