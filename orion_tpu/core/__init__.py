@@ -1,0 +1,1 @@
+"""Domain model: trials, experiments, worker runtime."""

@@ -1,0 +1,237 @@
+"""Trial entity: the unit of optimization work.
+
+Capability parity: reference `src/orion/core/worker/trial.py` (status machine
+``new -> reserved -> completed | interrupted | broken | suspended``, nested
+Param/Result values, md5 identity over params+experiment+lie flag, single-
+objective accessors).  Host-only code — trials are the coordination currency
+between workers; device code never sees them (it sees the flat arrays the
+Space codec produces from their params).
+"""
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+
+
+ALL_STATUSES = (
+    "new",
+    "reserved",
+    "suspended",
+    "completed",
+    "interrupted",
+    "broken",
+)
+
+#: Statuses a worker may atomically reserve from (reference `legacy.py:253-273`).
+RESERVABLE_STATUSES = ("new", "suspended", "interrupted")
+
+#: Statuses meaning the trial will make no further progress.
+STOPPED_STATUSES = ("completed", "interrupted", "broken")
+
+RESULT_TYPES = ("objective", "constraint", "gradient", "statistic", "lie")
+PARAM_TYPES = ("integer", "real", "categorical", "fidelity")
+
+
+def _canonical(value):
+    """Print-independent canonical form of a param value for hashing.
+
+    ``repr`` of numpy arrays is truncated by print options, so distinct large
+    arrays would collide; normalize array-likes to full nested lists first.
+    """
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return repr(value.tolist())
+        if isinstance(value, np.generic):
+            return repr(value.item())
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(value, (list, tuple)):
+        return repr([_canonical(v) for v in value])
+    return repr(value)
+
+
+def validate_status(status):
+    if status is not None and status not in ALL_STATUSES:
+        raise ValueError(f"Invalid trial status {status!r}; one of {ALL_STATUSES}")
+    return status
+
+
+@dataclass
+class Result:
+    """One reported value: ``{"name", "type", "value"}``."""
+
+    name: str
+    type: str
+    value: object
+
+    def __post_init__(self):
+        if self.type not in RESULT_TYPES:
+            raise ValueError(f"Invalid result type {self.type!r}; one of {RESULT_TYPES}")
+
+    def to_dict(self):
+        return {"name": self.name, "type": self.type, "value": self.value}
+
+
+class Trial:
+    """A single evaluation of the user's black box at one point of the space."""
+
+    __slots__ = (
+        "experiment",
+        "_status",
+        "params",
+        "results",
+        "worker",
+        "submit_time",
+        "start_time",
+        "end_time",
+        "heartbeat",
+        "working_dir",
+        "parents",
+        "_id_override",
+    )
+
+    def __init__(
+        self,
+        experiment=None,
+        status="new",
+        params=None,
+        results=None,
+        worker=None,
+        submit_time=None,
+        start_time=None,
+        end_time=None,
+        heartbeat=None,
+        working_dir=None,
+        parents=None,
+        _id=None,
+        **_ignored,
+    ):
+        self.experiment = experiment
+        self._status = validate_status(status) or "new"
+        self.params = dict(params or {})
+        self.results = [r if isinstance(r, Result) else Result(**r) for r in (results or [])]
+        self.worker = worker
+        self.submit_time = submit_time
+        self.start_time = start_time
+        self.end_time = end_time
+        self.heartbeat = heartbeat
+        self.working_dir = working_dir
+        self.parents = list(parents or [])
+        self._id_override = _id
+
+    # --- status machine ---------------------------------------------------
+    @property
+    def status(self):
+        return self._status
+
+    @status.setter
+    def status(self, value):
+        self._status = validate_status(value)
+
+    @property
+    def is_stopped(self):
+        return self._status in STOPPED_STATUSES
+
+    # --- identity ---------------------------------------------------------
+    @property
+    def id(self):
+        """Deterministic md5 identity (reference `trial.py:293-309`).
+
+        Hash of experiment + sorted params (+ a lie marker), so the same point
+        registered twice collides on the storage unique index — which is how
+        duplicate suggestions are detected across concurrent producers.
+        """
+        if self._id_override is not None:
+            return self._id_override
+        return self.compute_id(self.experiment, self.params, lie=bool(self.lie))
+
+    @staticmethod
+    def compute_id(experiment, params, lie=False):
+        payload = repr(
+            (
+                str(experiment),
+                sorted((str(k), _canonical(v)) for k, v in params.items()),
+                bool(lie),
+            )
+        )
+        return hashlib.md5(payload.encode("utf-8")).hexdigest()
+
+    @property
+    def hash_params(self):
+        """Identity of the parameter point alone (used for cross-status dedup)."""
+        return Trial.compute_id(self.experiment, self.params, lie=False)
+
+    # --- results accessors (single-objective, reference `trial.py:311-333`) ---
+    def _fetch_one(self, rtype):
+        for result in self.results:
+            if result.type == rtype:
+                return result
+        return None
+
+    @property
+    def objective(self):
+        return self._fetch_one("objective")
+
+    @property
+    def lie(self):
+        return self._fetch_one("lie")
+
+    @property
+    def gradient(self):
+        return self._fetch_one("gradient")
+
+    @property
+    def constraints(self):
+        return [r for r in self.results if r.type == "constraint"]
+
+    @property
+    def statistics(self):
+        return [r for r in self.results if r.type == "statistic"]
+
+    # --- serialization ------------------------------------------------------
+    def to_dict(self):
+        return {
+            "_id": self.id,
+            "experiment": self.experiment,
+            "status": self._status,
+            "params": dict(self.params),
+            "results": [r.to_dict() for r in self.results],
+            "worker": self.worker,
+            "submit_time": self.submit_time,
+            "start_time": self.start_time,
+            "end_time": self.end_time,
+            "heartbeat": self.heartbeat,
+            "working_dir": self.working_dir,
+            "parents": list(self.parents),
+        }
+
+    @classmethod
+    def from_dict(cls, doc):
+        doc = dict(doc)
+        doc.pop("exp_working_dir", None)
+        return cls(**doc)
+
+    # --- misc ---------------------------------------------------------------
+    @property
+    def duration(self):
+        if self.start_time is None:
+            return 0.0
+        end = self.end_time if self.end_time is not None else time.time()
+        return end - self.start_time
+
+    def params_repr(self, sep=","):
+        return sep.join(f"{k}:{v}" for k, v in sorted(self.params.items()))
+
+    def __eq__(self, other):
+        return isinstance(other, Trial) and self.id == other.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __repr__(self):
+        return (
+            f"Trial(experiment={self.experiment!r}, status={self._status!r}, "
+            f"params={self.params_repr()})"
+        )

@@ -1,0 +1,36 @@
+"""Storage: the distributed coordination backend.
+
+What NCCL is to a trainer, atomic ``read_and_write`` on the trials collection
+is to this framework (see SURVEY.md §2.3/§5): all inter-worker communication
+— trial queue, reservation locking, heartbeats, experiment configs, EVC links
+— flows through a shared document store.  Backends:
+
+- ``memory`` — in-process, for tests/--debug (reference EphemeralDB).
+- ``pickled`` — single file + advisory file lock, multi-process safe on one
+  node (reference PickledDB); the default.
+
+Intra-suggest parallelism (on-device vmap/shard_map over a TPU mesh) is a
+*different* layer — see ``orion_tpu.parallel``.
+"""
+
+from orion_tpu.storage.base import (
+    BaseStorage,
+    DocumentStorage,
+    ReadOnlyStorage,
+    create_storage,
+    get_storage,
+    setup_storage,
+)
+from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.storage.backends import PickledDB
+
+__all__ = [
+    "BaseStorage",
+    "DocumentStorage",
+    "MemoryDB",
+    "PickledDB",
+    "ReadOnlyStorage",
+    "create_storage",
+    "get_storage",
+    "setup_storage",
+]

@@ -1,0 +1,123 @@
+"""Durable single-file backend with cross-process locking.
+
+Capability parity: reference `src/orion/core/io/database/pickleddb.py` — every
+operation takes an advisory file lock, unpickles the in-memory DB, applies the
+op, and atomically rewrites the file (write-to-temp + rename).  The reference
+uses the `filelock` package with a 60s timeout; here the lock is `fcntl.flock`
+on a sidecar ``<path>.lock`` file (stdlib-only, correct across processes on
+one node — the same guarantee the reference offers).
+"""
+
+import contextlib
+import fcntl
+import os
+import pickle
+import tempfile
+import time
+
+from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.utils.exceptions import DatabaseError
+
+DEFAULT_LOCK_TIMEOUT = 60.0
+
+
+class LockAcquisitionTimeout(DatabaseError):
+    """Could not obtain the database file lock in time."""
+
+
+@contextlib.contextmanager
+def _file_lock(lock_path, timeout=DEFAULT_LOCK_TIMEOUT, poll=0.01):
+    fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
+    deadline = time.monotonic() + timeout
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise LockAcquisitionTimeout(
+                        f"could not lock {lock_path} within {timeout}s"
+                    )
+                time.sleep(poll)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+class PickledDB:
+    """File-backed document DB; safe for many concurrent worker processes."""
+
+    def __init__(self, path, lock_timeout=DEFAULT_LOCK_TIMEOUT):
+        self.path = os.path.abspath(os.path.expanduser(path))
+        self.lock_timeout = lock_timeout
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        # Index definitions must survive reloads, so they are applied to the
+        # pickled state itself on every ensure_index.
+
+    @property
+    def _lock_path(self):
+        return self.path + ".lock"
+
+    def _load(self):
+        if not os.path.exists(self.path) or os.path.getsize(self.path) == 0:
+            return MemoryDB()
+        with open(self.path, "rb") as handle:
+            return pickle.load(handle)
+
+    def _dump(self, db):
+        dirname = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".dbtmp-")
+        try:
+            with os.fdopen(fd, "wb") as handle:
+                pickle.dump(db, handle)
+            os.replace(tmp, self.path)  # atomic on POSIX
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
+
+    @contextlib.contextmanager
+    def _locked(self, write=True):
+        with _file_lock(self._lock_path, timeout=self.lock_timeout):
+            db = self._load()
+            yield db
+            if write:
+                self._dump(db)
+
+    # --- AbstractDB contract ------------------------------------------------
+    def ensure_index(self, collection, keys, unique=False):
+        with self._locked() as db:
+            db.ensure_index(collection, keys, unique=unique)
+
+    def index_information(self, collection):
+        with self._locked(write=False) as db:
+            return db.index_information(collection)
+
+    def drop_index(self, collection, name):
+        with self._locked() as db:
+            db.drop_index(collection, name)
+
+    def write(self, collection, data, query=None):
+        with self._locked() as db:
+            return db.write(collection, data, query)
+
+    def read(self, collection, query=None, projection=None):
+        with self._locked(write=False) as db:
+            return db.read(collection, query, projection)
+
+    def read_and_write(self, collection, query, data):
+        with self._locked() as db:
+            return db.read_and_write(collection, query, data)
+
+    def count(self, collection, query=None):
+        with self._locked(write=False) as db:
+            return db.count(collection, query)
+
+    def remove(self, collection, query=None):
+        with self._locked() as db:
+            return db.remove(collection, query)

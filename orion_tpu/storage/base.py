@@ -1,0 +1,323 @@
+"""Storage protocol: every coordination primitive workers rely on.
+
+Capability parity: reference `src/orion/storage/base.py` (BaseStorageProtocol,
+singleton access) + `src/orion/storage/legacy.py` (protocol mapped onto a
+document DB: unique (name, version) experiment index, atomic trial
+reservation via find-one-and-update, CAS status updates raising FailedUpdate,
+stale-heartbeat lost-trial queries, lies in a separate collection).
+
+Timestamps are ``time.time()`` floats everywhere (device-friendly and
+pickle-stable), not datetimes.
+"""
+
+import time
+
+from orion_tpu.core.trial import RESERVABLE_STATUSES, Trial
+from orion_tpu.storage.backends import PickledDB
+from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.utils.exceptions import DatabaseError, FailedUpdate
+
+
+class BaseStorage:
+    """Abstract protocol; see :class:`DocumentStorage` for the semantics."""
+
+    def create_experiment(self, config):
+        raise NotImplementedError
+
+    def update_experiment(self, experiment=None, uid=None, where=None, **kwargs):
+        raise NotImplementedError
+
+    def fetch_experiments(self, query, projection=None):
+        raise NotImplementedError
+
+    def register_trial(self, trial):
+        raise NotImplementedError
+
+    def register_lie(self, trial):
+        raise NotImplementedError
+
+    def fetch_lies(self, experiment):
+        raise NotImplementedError
+
+    def reserve_trial(self, experiment):
+        raise NotImplementedError
+
+    def fetch_trials(self, experiment=None, uid=None):
+        raise NotImplementedError
+
+    def fetch_trials_by_status(self, experiment, status):
+        raise NotImplementedError
+
+    def get_trial(self, trial=None, uid=None):
+        raise NotImplementedError
+
+    def set_trial_status(self, trial, status, was=None):
+        raise NotImplementedError
+
+    def update_heartbeat(self, trial):
+        raise NotImplementedError
+
+    def fetch_lost_trials(self, experiment, timeout):
+        raise NotImplementedError
+
+    def push_trial_results(self, trial):
+        raise NotImplementedError
+
+    def update_completed_trial(self, trial, results):
+        raise NotImplementedError
+
+    def count_completed_trials(self, experiment):
+        raise NotImplementedError
+
+    def count_broken_trials(self, experiment):
+        raise NotImplementedError
+
+    def fetch_noncompleted_trials(self, experiment):
+        raise NotImplementedError
+
+
+class DocumentStorage(BaseStorage):
+    """Protocol over any AbstractDB-style document backend."""
+
+    def __init__(self, db):
+        self._db = db
+        self._setup_indexes()
+
+    @property
+    def db(self):
+        return self._db
+
+    def _setup_indexes(self):
+        # Reference `legacy.py:70-88`.
+        self._db.ensure_index("experiments", ["name", "version"], unique=True)
+        self._db.ensure_index("trials", ["experiment"])
+        self._db.ensure_index("trials", ["status"])
+        self._db.ensure_index("trials", ["experiment", "status"])
+        self._db.ensure_index("lying_trials", ["experiment"])
+
+    # --- experiments --------------------------------------------------------
+    def create_experiment(self, config):
+        """Insert a new experiment config; DuplicateKeyError if (name, version)
+        already exists — callers translate that into a RaceCondition retry."""
+        config = dict(config)
+        config.setdefault("version", 1)
+        _id = self._db.write("experiments", config)
+        config["_id"] = _id
+        return config
+
+    def update_experiment(self, experiment=None, uid=None, where=None, **kwargs):
+        query = dict(where or {})
+        if uid is not None:
+            query["_id"] = uid
+        elif experiment is not None:
+            query["_id"] = experiment["_id"]
+        if not query:
+            # Reference raises MissingArguments here (`legacy.py:94-109`);
+            # never allow an accidental collection-wide update.
+            raise DatabaseError(
+                "update_experiment requires an experiment, uid, or where query"
+            )
+        return self._db.write("experiments", kwargs, query=query)
+
+    def fetch_experiments(self, query, projection=None):
+        return self._db.read("experiments", query, projection)
+
+    # --- trials -------------------------------------------------------------
+    def register_trial(self, trial):
+        """Insert a new trial; DuplicateKeyError on a duplicate point id."""
+        trial.submit_time = trial.submit_time or time.time()
+        self._db.write("trials", trial.to_dict())
+        return trial
+
+    def register_lie(self, trial):
+        trial.submit_time = trial.submit_time or time.time()
+        self._db.write("lying_trials", trial.to_dict())
+        return trial
+
+    def fetch_lies(self, experiment):
+        docs = self._db.read("lying_trials", {"experiment": _exp_id(experiment)})
+        return [Trial.from_dict(d) for d in docs]
+
+    def reserve_trial(self, experiment):
+        """Atomically claim one pending trial (the cross-worker sync point;
+        reference `legacy.py:253-273`)."""
+        now = time.time()
+        doc = self._db.read_and_write(
+            "trials",
+            {
+                "experiment": _exp_id(experiment),
+                "status": {"$in": list(RESERVABLE_STATUSES)},
+            },
+            {"status": "reserved", "start_time": now, "heartbeat": now},
+        )
+        return Trial.from_dict(doc) if doc else None
+
+    def fetch_trials(self, experiment=None, uid=None):
+        query = {"experiment": uid if uid is not None else _exp_id(experiment)}
+        docs = self._db.read("trials", query)
+        docs.sort(key=lambda d: (d.get("submit_time") or 0.0, str(d.get("_id"))))
+        return [Trial.from_dict(d) for d in docs]
+
+    def fetch_trials_by_status(self, experiment, status):
+        statuses = [status] if isinstance(status, str) else list(status)
+        docs = self._db.read(
+            "trials",
+            {"experiment": _exp_id(experiment), "status": {"$in": statuses}},
+        )
+        return [Trial.from_dict(d) for d in docs]
+
+    def get_trial(self, trial=None, uid=None):
+        _id = uid if uid is not None else trial.id
+        docs = self._db.read("trials", {"_id": _id})
+        return Trial.from_dict(docs[0]) if docs else None
+
+    def set_trial_status(self, trial, status, was=None):
+        """Compare-and-swap status update (reference `legacy.py:223-243`).
+
+        Always guarded: the swap only succeeds if the stored status equals
+        ``was`` (defaulting to the caller's in-memory view, so a concurrent
+        transition by another worker raises FailedUpdate instead of being
+        silently overwritten).
+        """
+        query = {"_id": trial.id, "status": was if was is not None else trial.status}
+        update = {"status": status}
+        if status in ("completed", "interrupted", "broken"):
+            update["end_time"] = time.time()
+        doc = self._db.read_and_write("trials", query, update)
+        if doc is None:
+            raise FailedUpdate(
+                f"trial {trial.id} not updated to {status!r} (was={was!r})"
+            )
+        trial.status = status
+        return Trial.from_dict(doc)
+
+    def update_heartbeat(self, trial):
+        doc = self._db.read_and_write(
+            "trials",
+            {"_id": trial.id, "status": "reserved"},
+            {"heartbeat": time.time()},
+        )
+        if doc is None:
+            raise FailedUpdate(f"trial {trial.id} is no longer reserved")
+
+    def fetch_lost_trials(self, experiment, timeout):
+        """Reserved trials whose worker stopped heartbeating (crashed/killed)."""
+        threshold = time.time() - timeout
+        docs = self._db.read(
+            "trials",
+            {
+                "experiment": _exp_id(experiment),
+                "status": "reserved",
+                "heartbeat": {"$lt": threshold},
+            },
+        )
+        return [Trial.from_dict(d) for d in docs]
+
+    def push_trial_results(self, trial):
+        doc = self._db.read_and_write(
+            "trials",
+            {"_id": trial.id, "status": "reserved"},
+            {"results": [r.to_dict() for r in trial.results]},
+        )
+        if doc is None:
+            raise FailedUpdate(f"cannot push results of non-reserved trial {trial.id}")
+        return Trial.from_dict(doc)
+
+    def update_completed_trial(self, trial, results):
+        trial.results = list(results)
+        trial.end_time = time.time()
+        doc = self._db.read_and_write(
+            "trials",
+            {"_id": trial.id},
+            {
+                "results": [r.to_dict() for r in trial.results],
+                "end_time": trial.end_time,
+                "status": "completed",
+            },
+        )
+        if doc is None:
+            raise FailedUpdate(f"completed trial {trial.id} vanished from storage")
+        trial.status = "completed"
+        return trial
+
+    def count_completed_trials(self, experiment):
+        return self._db.count(
+            "trials", {"experiment": _exp_id(experiment), "status": "completed"}
+        )
+
+    def count_broken_trials(self, experiment):
+        return self._db.count(
+            "trials", {"experiment": _exp_id(experiment), "status": "broken"}
+        )
+
+    def fetch_noncompleted_trials(self, experiment):
+        docs = self._db.read(
+            "trials",
+            {"experiment": _exp_id(experiment), "status": {"$ne": "completed"}},
+        )
+        return [Trial.from_dict(d) for d in docs]
+
+
+def _exp_id(experiment):
+    if isinstance(experiment, dict):
+        return experiment["_id"]
+    if hasattr(experiment, "id"):
+        return experiment.id
+    return experiment
+
+
+_READONLY_METHODS = {
+    "fetch_experiments",
+    "fetch_trials",
+    "fetch_trials_by_status",
+    "fetch_lies",
+    "fetch_lost_trials",
+    "fetch_noncompleted_trials",
+    "get_trial",
+    "count_completed_trials",
+    "count_broken_trials",
+}
+
+
+class ReadOnlyStorage:
+    """Whitelist proxy (reference `storage/base.py:251-281`)."""
+
+    def __init__(self, storage):
+        self._storage = storage
+
+    def __getattr__(self, name):
+        if name not in _READONLY_METHODS:
+            raise AttributeError(f"{name!r} is not a read-only storage operation")
+        return getattr(self._storage, name)
+
+
+def create_storage(config=None):
+    """Build a storage instance from a config dict.
+
+    ``{"type": "memory"}`` or ``{"type": "pickled", "path": ...}``.
+    """
+    config = dict(config or {})
+    db_type = config.get("type", "pickled")
+    if db_type in ("memory", "ephemeral"):
+        return DocumentStorage(MemoryDB())
+    if db_type in ("pickled", "pickleddb"):
+        path = config.get("path", "orion_tpu_db.pkl")
+        return DocumentStorage(PickledDB(path, lock_timeout=config.get("lock_timeout", 60.0)))
+    raise DatabaseError(f"Unknown storage type {db_type!r}")
+
+
+_storage_singleton = None
+
+
+def setup_storage(config=None, force=False):
+    """Initialize the process-wide storage singleton."""
+    global _storage_singleton
+    if _storage_singleton is None or force:
+        _storage_singleton = create_storage(config)
+    return _storage_singleton
+
+
+def get_storage():
+    if _storage_singleton is None:
+        raise DatabaseError("storage singleton not initialized; call setup_storage()")
+    return _storage_singleton

@@ -1,0 +1,259 @@
+"""In-memory Mongo-flavored document store.
+
+Capability parity: reference `src/orion/core/io/database/ephemeraldb.py`
+(collections of flattened documents, unique indexes with duplicate detection,
+query operators ``$ne,$in,$gte,$gt,$lte,$lt``, projection semantics) and the
+`AbstractDB` contract from `src/orion/core/io/database/__init__.py`
+(read/write/read_and_write/count/remove/ensure_index + DuplicateKeyError).
+
+This is the reference model for correctness; the pickled file backend wraps
+one of these under a cross-process file lock.
+"""
+
+import copy
+import threading
+
+from orion_tpu.utils.exceptions import DuplicateKeyError
+from orion_tpu.utils.flatten import flatten, unflatten
+
+_OPS = {
+    "$ne": lambda doc_val, qv: doc_val != qv,
+    "$in": lambda doc_val, qv: doc_val in qv,
+    "$gte": lambda doc_val, qv: doc_val is not None and doc_val >= qv,
+    "$gt": lambda doc_val, qv: doc_val is not None and doc_val > qv,
+    "$lte": lambda doc_val, qv: doc_val is not None and doc_val <= qv,
+    "$lt": lambda doc_val, qv: doc_val is not None and doc_val < qv,
+}
+
+
+def _match_value(doc_val, query_val):
+    if isinstance(query_val, dict) and any(k.startswith("$") for k in query_val):
+        return all(_OPS[op](doc_val, qv) for op, qv in query_val.items())
+    return doc_val == query_val
+
+
+def _matches(flat_doc, nested_doc, query):
+    for key, qv in (query or {}).items():
+        if key in flat_doc:
+            if not _match_value(flat_doc[key], qv):
+                return False
+        else:
+            # dotted key may address a whole subdocument or a missing field
+            sub = nested_doc
+            found = True
+            for part in key.split("."):
+                if isinstance(sub, dict) and part in sub:
+                    sub = sub[part]
+                else:
+                    found = False
+                    break
+            if not _match_value(sub if found else None, qv):
+                return False
+    return True
+
+
+def _project(nested_doc, projection):
+    if not projection:
+        return copy.deepcopy(nested_doc)
+    keep_id = projection.get("_id", 1)
+    selected = {k for k, v in projection.items() if v and k != "_id"}
+    if not selected:  # exclusion-style projection not needed by the framework
+        out = copy.deepcopy(nested_doc)
+        if not keep_id:
+            out.pop("_id", None)
+        return out
+    flat = flatten(nested_doc)
+    out = {}
+    for key, value in flat.items():
+        if any(key == s or key.startswith(s + ".") for s in selected):
+            out[key] = copy.deepcopy(value)
+    if keep_id and "_id" in nested_doc:
+        out["_id"] = nested_doc["_id"]
+    return unflatten(out)
+
+
+class Collection:
+    """One named collection of documents with unique-index enforcement."""
+
+    def __init__(self):
+        self._docs = {}  # _id -> nested document
+        self._indexes = {}  # name -> (tuple of fields, unique)
+        self._auto_id = 0
+
+    # --- indexes ----------------------------------------------------------
+    def ensure_index(self, keys, unique=False):
+        fields = tuple(k[0] if isinstance(k, (tuple, list)) else k for k in keys)
+        name = "_".join(fields) + "_1"
+        self._indexes[name] = (fields, unique)
+
+    def index_information(self):
+        return {name: unique for name, (_, unique) in self._indexes.items()}
+
+    def drop_index(self, name):
+        if name not in self._indexes:
+            raise KeyError(f"index not found: {name}")
+        del self._indexes[name]
+
+    def _index_key(self, doc, fields):
+        flat = flatten(doc)
+        return tuple(flat.get(f) for f in fields)
+
+    def _check_unique(self, doc, ignore_id=None):
+        for fields, unique in self._indexes.values():
+            if not unique:
+                continue
+            key = self._index_key(doc, fields)
+            for other_id, other in self._docs.items():
+                if other_id == ignore_id:
+                    continue
+                if self._index_key(other, fields) == key:
+                    raise DuplicateKeyError(
+                        f"duplicate key on index {fields} with value {key}"
+                    )
+
+    # --- CRUD --------------------------------------------------------------
+    def insert(self, doc):
+        doc = copy.deepcopy(doc)
+        if "_id" not in doc:
+            self._auto_id += 1
+            doc["_id"] = self._auto_id
+        if doc["_id"] in self._docs:
+            raise DuplicateKeyError(f"duplicate _id {doc['_id']!r}")
+        self._check_unique(doc)
+        self._docs[doc["_id"]] = doc
+        return doc["_id"]
+
+    def find(self, query=None, projection=None):
+        out = []
+        for doc in self._docs.values():
+            if _matches(flatten(doc), doc, query):
+                out.append(_project(doc, projection))
+        return out
+
+    def _apply_update(self, doc, update):
+        # Walk dotted update keys into the nested doc directly — never
+        # flatten/unflatten the whole document, which would restructure any
+        # stored key that itself contains a "." (e.g. a param named "opt.lr").
+        sets = update.get("$set") if any(k.startswith("$") for k in update) else update
+        unsets = update.get("$unset", {})
+        new_doc = copy.deepcopy(doc)
+        for key, value in (sets or {}).items():
+            parts = key.split(".")
+            node = new_doc
+            for part in parts[:-1]:
+                if not isinstance(node.get(part), dict):
+                    node[part] = {}
+                node = node[part]
+            node[parts[-1]] = copy.deepcopy(value)
+        for key in unsets:
+            parts = key.split(".")
+            node = new_doc
+            for part in parts[:-1]:
+                node = node.get(part)
+                if not isinstance(node, dict):
+                    node = None
+                    break
+            if isinstance(node, dict):
+                node.pop(parts[-1], None)
+        return new_doc
+
+    def update(self, query, update, many=True):
+        count = 0
+        for _id, doc in list(self._docs.items()):
+            if not _matches(flatten(doc), doc, query):
+                continue
+            new_doc = self._apply_update(doc, update)
+            new_doc["_id"] = _id
+            self._check_unique(new_doc, ignore_id=_id)
+            self._docs[_id] = new_doc
+            count += 1
+            if not many:
+                break
+        return count
+
+    def find_one_and_update(self, query, update, return_new=True):
+        """Atomic single-document compare-and-swap (the sync primitive)."""
+        for _id, doc in self._docs.items():
+            if _matches(flatten(doc), doc, query):
+                new_doc = self._apply_update(doc, update)
+                new_doc["_id"] = _id
+                self._check_unique(new_doc, ignore_id=_id)
+                self._docs[_id] = new_doc
+                return copy.deepcopy(new_doc if return_new else doc)
+        return None
+
+    def count(self, query=None):
+        return len(self.find(query, projection={"_id": 1}))
+
+    def remove(self, query=None):
+        doomed = [
+            _id
+            for _id, doc in self._docs.items()
+            if _matches(flatten(doc), doc, query)
+        ]
+        for _id in doomed:
+            del self._docs[_id]
+        return len(doomed)
+
+
+class MemoryDB:
+    """Thread-safe in-memory database of named collections."""
+
+    def __init__(self):
+        self._collections = {}
+        self._lock = threading.RLock()
+
+    def __getstate__(self):
+        # The RLock is process-local; the pickled backend provides its own
+        # cross-process file lock.
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
+
+    def _col(self, name):
+        if name not in self._collections:
+            self._collections[name] = Collection()
+        return self._collections[name]
+
+    # AbstractDB-style contract (reference `database/__init__.py:23-264`)
+    def ensure_index(self, collection, keys, unique=False):
+        with self._lock:
+            self._col(collection).ensure_index(keys, unique=unique)
+
+    def index_information(self, collection):
+        with self._lock:
+            return self._col(collection).index_information()
+
+    def drop_index(self, collection, name):
+        with self._lock:
+            self._col(collection).drop_index(name)
+
+    def write(self, collection, data, query=None):
+        """Insert when no query; update-many when query given."""
+        with self._lock:
+            col = self._col(collection)
+            if query is None:
+                if isinstance(data, (list, tuple)):
+                    return [col.insert(doc) for doc in data]
+                return col.insert(data)
+            return col.update(query, data, many=True)
+
+    def read(self, collection, query=None, projection=None):
+        with self._lock:
+            return self._col(collection).find(query, projection)
+
+    def read_and_write(self, collection, query, data):
+        with self._lock:
+            return self._col(collection).find_one_and_update(query, data)
+
+    def count(self, collection, query=None):
+        with self._lock:
+            return self._col(collection).count(query)
+
+    def remove(self, collection, query=None):
+        with self._lock:
+            return self._col(collection).remove(query)

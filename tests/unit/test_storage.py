@@ -1,0 +1,259 @@
+"""Storage contract tests, run over both backends.
+
+Parity model: reference tests/unittests/storage/test_storage.py (protocol
+contract under OrionState) + core/test_ephemeraldb.py / test_pickleddb.py.
+"""
+
+import multiprocessing
+import time
+
+import pytest
+
+from orion_tpu.core.trial import Trial
+from orion_tpu.storage import MemoryDB, PickledDB, create_storage
+from orion_tpu.storage.base import DocumentStorage, ReadOnlyStorage
+from orion_tpu.utils.exceptions import DuplicateKeyError, FailedUpdate
+
+
+@pytest.fixture(params=["memory", "pickled"])
+def storage(request, tmp_path):
+    if request.param == "memory":
+        return create_storage({"type": "memory"})
+    return create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+
+
+def new_trial(i=0, experiment="exp-id", **kw):
+    return Trial(experiment=experiment, params={"x": float(i)}, **kw)
+
+
+# --- document DB semantics -------------------------------------------------
+
+
+def test_db_write_read_count_remove():
+    db = MemoryDB()
+    db.write("c", {"a": 1, "b": {"c": 2}})
+    db.write("c", {"a": 2, "b": {"c": 3}})
+    assert db.count("c") == 2
+    assert db.count("c", {"a": 1}) == 1
+    assert db.read("c", {"b.c": {"$gte": 3}})[0]["a"] == 2
+    assert db.read("c", {"a": {"$in": [2, 5]}})[0]["a"] == 2
+    assert db.read("c", {"a": {"$ne": 2}})[0]["a"] == 1
+    db.remove("c", {"a": 1})
+    assert db.count("c") == 1
+
+
+def test_db_update_with_query():
+    db = MemoryDB()
+    db.write("c", {"a": 1, "st": "new"})
+    db.write("c", {"a": 2, "st": "new"})
+    n = db.write("c", {"st": "old"}, query={"st": "new"})
+    assert n == 2
+    assert db.count("c", {"st": "old"}) == 2
+
+
+def test_db_projection():
+    db = MemoryDB()
+    db.write("c", {"a": 1, "b": {"c": 2, "d": 3}})
+    out = db.read("c", projection={"b.c": 1})
+    assert out[0]["b"] == {"c": 2}
+    assert "a" not in out[0]
+    assert "_id" in out[0]
+
+
+def test_db_unique_index():
+    db = MemoryDB()
+    db.ensure_index("c", ["name", "version"], unique=True)
+    db.write("c", {"name": "n", "version": 1})
+    with pytest.raises(DuplicateKeyError):
+        db.write("c", {"name": "n", "version": 1})
+    db.write("c", {"name": "n", "version": 2})
+
+
+def test_db_read_and_write_atomic_semantics():
+    db = MemoryDB()
+    db.write("c", {"a": 1, "st": "new"})
+    doc = db.read_and_write("c", {"st": "new"}, {"st": "go"})
+    assert doc["st"] == "go"
+    assert db.read_and_write("c", {"st": "new"}, {"st": "go"}) is None
+
+
+def test_pickled_persists_across_instances(tmp_path):
+    path = str(tmp_path / "db.pkl")
+    db1 = PickledDB(path)
+    db1.write("c", {"a": 1})
+    db2 = PickledDB(path)
+    assert db2.count("c") == 1
+
+
+# --- storage protocol ------------------------------------------------------
+
+
+def test_experiment_unique_name_version(storage):
+    storage.create_experiment({"name": "n", "version": 1})
+    with pytest.raises(DuplicateKeyError):
+        storage.create_experiment({"name": "n", "version": 1})
+    storage.create_experiment({"name": "n", "version": 2})
+    assert len(storage.fetch_experiments({"name": "n"})) == 2
+
+
+def test_register_and_fetch_trials(storage):
+    for i in range(3):
+        storage.register_trial(new_trial(i))
+    trials = storage.fetch_trials(uid="exp-id")
+    assert len(trials) == 3
+    assert all(t.status == "new" for t in trials)
+    assert all(t.submit_time is not None for t in trials)
+
+
+def test_register_duplicate_trial_raises(storage):
+    storage.register_trial(new_trial(1))
+    with pytest.raises(DuplicateKeyError):
+        storage.register_trial(new_trial(1))
+
+
+def test_reserve_trial_claims_each_once(storage):
+    for i in range(2):
+        storage.register_trial(new_trial(i))
+    t1 = storage.reserve_trial("exp-id")
+    t2 = storage.reserve_trial("exp-id")
+    t3 = storage.reserve_trial("exp-id")
+    assert t1.status == t2.status == "reserved"
+    assert {t1.id, t2.id} == {t.id for t in storage.fetch_trials(uid="exp-id")}
+    assert t3 is None
+
+
+def test_cas_status_update(storage):
+    trial = storage.register_trial(new_trial())
+    storage.set_trial_status(trial, "reserved", was="new")
+    with pytest.raises(FailedUpdate):
+        storage.set_trial_status(trial, "completed", was="new")
+    storage.set_trial_status(trial, "completed", was="reserved")
+    assert storage.get_trial(uid=trial.id).status == "completed"
+    assert storage.get_trial(uid=trial.id).end_time is not None
+
+
+def test_heartbeat_and_lost_trials(storage):
+    trial = storage.register_trial(new_trial())
+    reserved = storage.reserve_trial("exp-id")
+    assert storage.fetch_lost_trials("exp-id", timeout=1000.0) == []
+    # Backdate the heartbeat directly to simulate a dead worker.
+    storage.db.write("trials", {"heartbeat": time.time() - 9999}, {"_id": trial.id})
+    lost = storage.fetch_lost_trials("exp-id", timeout=120.0)
+    assert [t.id for t in lost] == [reserved.id]
+    storage.update_heartbeat(reserved)
+    assert storage.fetch_lost_trials("exp-id", timeout=120.0) == []
+
+
+def test_heartbeat_fails_on_unreserved(storage):
+    trial = storage.register_trial(new_trial())
+    with pytest.raises(FailedUpdate):
+        storage.update_heartbeat(trial)
+
+
+def test_update_completed_trial(storage):
+    from orion_tpu.core.trial import Result
+
+    storage.register_trial(new_trial())
+    trial = storage.reserve_trial("exp-id")
+    storage.update_completed_trial(trial, [Result("loss", "objective", 0.5)])
+    stored = storage.get_trial(uid=trial.id)
+    assert stored.status == "completed"
+    assert stored.objective.value == 0.5
+    assert storage.count_completed_trials("exp-id") == 1
+
+
+def test_lies_are_separate(storage):
+    lie = new_trial(results=[{"name": "o", "type": "lie", "value": 1.0}])
+    storage.register_lie(lie)
+    assert storage.fetch_trials(uid="exp-id") == []
+    lies = storage.fetch_lies("exp-id")
+    assert len(lies) == 1
+    assert lies[0].lie.value == 1.0
+
+
+def test_counts_and_noncompleted(storage):
+    for i in range(3):
+        storage.register_trial(new_trial(i))
+    t = storage.reserve_trial("exp-id")
+    storage.set_trial_status(t, "broken", was="reserved")
+    assert storage.count_broken_trials("exp-id") == 1
+    assert storage.count_completed_trials("exp-id") == 0
+    assert len(storage.fetch_noncompleted_trials("exp-id")) == 3
+
+
+def test_readonly_storage_blocks_writes(storage):
+    ro = ReadOnlyStorage(storage)
+    assert ro.fetch_trials(uid="exp-id") == []
+    with pytest.raises(AttributeError):
+        ro.register_trial(new_trial())
+
+
+# --- multiprocess safety ---------------------------------------------------
+
+
+def _worker_reserve(path, out_queue):
+    storage = create_storage({"type": "pickled", "path": path})
+    claimed = []
+    while True:
+        trial = storage.reserve_trial("exp-id")
+        if trial is None:
+            break
+        claimed.append(trial.id)
+    out_queue.put(claimed)
+
+
+def test_concurrent_reservation_no_double_claims(tmp_path):
+    """N processes hammer reserve_trial; every trial is claimed exactly once."""
+    path = str(tmp_path / "db.pkl")
+    storage = create_storage({"type": "pickled", "path": path})
+    all_ids = set()
+    for i in range(20):
+        t = new_trial(i)
+        storage.register_trial(t)
+        all_ids.add(t.id)
+
+    ctx = multiprocessing.get_context("spawn")
+    queue = ctx.Queue()
+    procs = [ctx.Process(target=_worker_reserve, args=(path, queue)) for _ in range(4)]
+    for p in procs:
+        p.start()
+    results = [queue.get(timeout=60) for _ in procs]
+    for p in procs:
+        p.join(timeout=60)
+
+    flat = [tid for chunk in results for tid in chunk]
+    assert len(flat) == 20
+    assert set(flat) == all_ids
+
+
+# --- regression tests from review findings ---------------------------------
+
+
+def test_update_preserves_dotted_document_keys():
+    db = MemoryDB()
+    db.write("c", {"_id": "t", "params": {"opt.lr": 1}, "status": "new"})
+    db.read_and_write("c", {"_id": "t"}, {"status": "reserved"})
+    doc = db.read("c", {"_id": "t"})[0]
+    assert doc["params"] == {"opt.lr": 1}
+
+
+def test_update_dotted_key_over_scalar_parent():
+    db = MemoryDB()
+    db.write("c", {"_id": "t", "worker": 5})
+    db.read_and_write("c", {"_id": "t"}, {"worker.pid": 1})
+    assert db.read("c", {"_id": "t"})[0]["worker"] == {"pid": 1}
+
+
+def test_update_experiment_requires_selector(storage):
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    with pytest.raises(DatabaseError):
+        storage.update_experiment(status="done")
+
+
+def test_set_trial_status_guards_by_default(storage):
+    trial = storage.register_trial(new_trial())
+    other_view = storage.get_trial(uid=trial.id)
+    storage.set_trial_status(trial, "reserved")  # guard = in-memory "new"
+    with pytest.raises(FailedUpdate):
+        storage.set_trial_status(other_view, "completed")  # stale view: still "new"

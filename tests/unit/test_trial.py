@@ -1,0 +1,95 @@
+"""Trial entity tests (parity model: reference tests/unittests/core/test_trial.py)."""
+
+import pytest
+
+from orion_tpu.core.trial import Result, Trial
+
+
+def make_trial(**kw):
+    kw.setdefault("experiment", "exp1")
+    kw.setdefault("params", {"x": 1.5, "y": "relu"})
+    return Trial(**kw)
+
+
+def test_default_status_is_new():
+    assert make_trial().status == "new"
+
+
+def test_invalid_status_rejected():
+    with pytest.raises(ValueError):
+        make_trial(status="bogus")
+    trial = make_trial()
+    with pytest.raises(ValueError):
+        trial.status = "wat"
+
+
+def test_id_is_deterministic_and_param_order_free():
+    t1 = Trial(experiment="e", params={"a": 1, "b": 2})
+    t2 = Trial(experiment="e", params={"b": 2, "a": 1})
+    assert t1.id == t2.id
+    t3 = Trial(experiment="e", params={"a": 1, "b": 3})
+    assert t1.id != t3.id
+    t4 = Trial(experiment="other", params={"a": 1, "b": 2})
+    assert t1.id != t4.id
+
+
+def test_lie_changes_id():
+    t = make_trial()
+    lying = make_trial(results=[{"name": "obj", "type": "lie", "value": 3.0}])
+    assert t.id != lying.id
+    assert t.hash_params == lying.hash_params
+
+
+def test_objective_lie_gradient_accessors():
+    t = make_trial(
+        results=[
+            {"name": "o", "type": "objective", "value": 1.0},
+            {"name": "c", "type": "constraint", "value": 0.1},
+            {"name": "g", "type": "gradient", "value": [1, 2]},
+            {"name": "s", "type": "statistic", "value": 9},
+        ]
+    )
+    assert t.objective.value == 1.0
+    assert t.gradient.value == [1, 2]
+    assert t.lie is None
+    assert [c.value for c in t.constraints] == [0.1]
+    assert [s.value for s in t.statistics] == [9]
+
+
+def test_invalid_result_type():
+    with pytest.raises(ValueError):
+        Result(name="x", type="wat", value=1)
+
+
+def test_dict_roundtrip():
+    t = make_trial(
+        status="completed",
+        results=[{"name": "o", "type": "objective", "value": 2.5}],
+        parents=["abc"],
+        working_dir="/tmp/w",
+    )
+    t2 = Trial.from_dict(t.to_dict())
+    assert t2.id == t.id
+    assert t2.status == "completed"
+    assert t2.params == t.params
+    assert t2.objective.value == 2.5
+    assert t2.parents == ["abc"]
+
+
+def test_equality_and_hash():
+    assert make_trial() == make_trial()
+    assert len({make_trial(), make_trial()}) == 1
+
+
+def test_id_distinguishes_large_arrays():
+    import numpy as np
+
+    a = np.arange(2000.0)
+    b = a.copy()
+    b[1000] = -1.0
+    t1 = Trial(experiment="e", params={"w": a})
+    t2 = Trial(experiment="e", params={"w": b})
+    assert t1.id != t2.id
+    # and is stable across numpy print options
+    with np.printoptions(threshold=5):
+        assert Trial(experiment="e", params={"w": a}).id == t1.id
