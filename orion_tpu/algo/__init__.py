@@ -1,0 +1,12 @@
+"""Optimization algorithms.
+
+Capability parity: reference `src/orion/algo/` — abstract suggest/observe
+interface, plugin discovery, random search, ASHA — plus the TPU-native
+batched Bayesian optimizer (`tpu_bo`) that is this framework's reason to
+exist.  Algorithms operate on the Space's flat unit-cube codec so their hot
+paths are jitted, batched jnp code; trials and storage never reach device.
+"""
+
+from orion_tpu.algo.base import BaseAlgorithm, algo_registry, create_algo
+
+__all__ = ["BaseAlgorithm", "algo_registry", "create_algo"]

@@ -1,0 +1,185 @@
+"""Algorithm interface.
+
+Capability parity: reference `src/orion/algo/base.py` (BaseAlgorithm:
+suggest/observe/is_done/score/judge/should_suspend/state_dict/set_state/
+seed_rng, nested config instantiation, Factory plugin discovery).
+
+TPU-first redesign: algorithms speak **flat unit-cube arrays**.  ``suggest``
+produces a ``(num, D)`` array in [0,1]^D through jitted device code and the
+framework decodes it to structured params via the Space codec; ``observe``
+receives the encoded array plus an objective vector.  The stateful-RNG
+contract of the reference (numpy RandomState in state_dict) becomes a JAX
+PRNGKey threaded through state — seeding is explicit and resumable.
+"""
+
+import numpy as np
+import jax
+
+from orion_tpu.space.space import Space
+from orion_tpu.utils.registry import Registry
+
+algo_registry = Registry("algo")
+
+
+class BaseAlgorithm:
+    """Base class for optimization algorithms.
+
+    Subclasses implement ``_suggest_cube(num)`` returning a ``(num, D)``
+    unit-cube array (or None to opt out this round, reference
+    `base.py:142-163`) and may override ``observe_arrays``.
+    """
+
+    requires_fidelity = False
+
+    def __init__(self, space, seed=None, **params):
+        if not isinstance(space, Space):
+            raise TypeError(f"space must be a Space, got {type(space)}")
+        self.space = space
+        self._params = dict(params)
+        self._seed = seed
+        self.rng_key = jax.random.PRNGKey(seed if seed is not None else 0)
+        # Observation history, host-side mirrors of device state.
+        self._n_observed = 0
+
+    # --- RNG ---------------------------------------------------------------
+    def seed_rng(self, seed):
+        """Reset the algorithm's PRNG stream (reference `base.py:121-128`)."""
+        self._seed = seed
+        self.rng_key = jax.random.PRNGKey(seed)
+
+    def next_key(self):
+        """Split off a fresh subkey (functional replacement for RandomState)."""
+        self.rng_key, sub = jax.random.split(self.rng_key)
+        return sub
+
+    # --- state -------------------------------------------------------------
+    def state_dict(self):
+        """Serializable snapshot; must capture everything ``set_state`` needs
+        to resume identically (reference `base.py:130-140`)."""
+        return {
+            "rng_key": np.asarray(self.rng_key).tolist(),
+            "n_observed": self._n_observed,
+        }
+
+    def set_state(self, state):
+        self.rng_key = jax.numpy.asarray(np.asarray(state["rng_key"], dtype=np.uint32))
+        self._n_observed = state["n_observed"]
+
+    # --- core contract -----------------------------------------------------
+    def suggest(self, num=1):
+        """Return ``num`` new points as a list of param dicts, or None to
+        signal a temporary opt-out (producer backs off and retries)."""
+        cube = self._suggest_cube(num)
+        if cube is None:
+            return None
+        arrays = self.space.decode_flat(cube)
+        return self.space.arrays_to_params(arrays, fidelity_value=self._fidelity_for_new())
+
+    def _suggest_cube(self, num):
+        raise NotImplementedError
+
+    def _fidelity_for_new(self):
+        """Fidelity assigned to fresh points (max budget unless multi-fidelity
+        algorithms override with rung budgets)."""
+        fid = self.space.fidelity
+        return fid.high if fid is not None else None
+
+    def observe(self, params_list, results):
+        """Feed evaluated points back.
+
+        ``results`` is a list of dicts with at least ``objective`` (reference
+        `base.py:165-191`).  The default implementation encodes points to the
+        unit cube and forwards to :meth:`observe_arrays`.
+        """
+        if not params_list:
+            return
+        arrays = self.space.params_to_arrays(params_list)
+        cube = self.space.encode_flat(arrays)
+        objectives = np.asarray(
+            [float(r["objective"]) for r in results], dtype=np.float64
+        )
+        fidelities = None
+        fid = self.space.fidelity
+        if fid is not None:
+            fidelities = np.asarray([p[fid.name] for p in params_list], dtype=np.int64)
+        self.observe_arrays(cube, objectives, params_list=params_list, fidelities=fidelities)
+        self._n_observed += len(params_list)
+
+    def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
+        """Device-facing observation hook; default is stateless."""
+
+    @property
+    def n_observed(self):
+        return self._n_observed
+
+    @property
+    def is_done(self):
+        """True when the algo cannot improve further (reference `base.py:193-196`)."""
+        return False
+
+    def score(self, params):  # pragma: no cover - default
+        """Prior preference score for a candidate point (reference `base.py:198-208`)."""
+        return 0
+
+    def judge(self, params, measurements):  # pragma: no cover - default
+        """Online early-stopping hook (reference `base.py:210-232`)."""
+        return None
+
+    @property
+    def should_suspend(self):  # pragma: no cover - default
+        return False
+
+    # --- configuration -----------------------------------------------------
+    @property
+    def configuration(self):
+        """Dict form used for storage/EVC comparison (reference `base.py:241-256`)."""
+        name = type(self).__name__.lower()
+        cfg = dict(self._params)
+        if self._seed is not None:
+            cfg["seed"] = self._seed
+        return {name: cfg}
+
+
+_BUILTIN_MODULES = (
+    "random_search",
+    "asha",
+    "hyperband",
+    "grid_search",
+    "tpe",
+    "tpu_bo",
+)
+
+
+def _import_builtins():
+    """Register built-in algorithms (entry points cover third-party ones)."""
+    import importlib
+
+    for mod in _BUILTIN_MODULES:
+        try:
+            importlib.import_module(f"orion_tpu.algo.{mod}")
+        except ImportError:  # pragma: no cover - during incremental build only
+            pass
+
+
+def create_algo(space, config=None, seed=None):
+    """Instantiate an algorithm from config.
+
+    ``config`` is either a name string (``"random"``) or a one-key dict
+    ``{"asha": {...kwargs}}`` like the reference's nested instantiation
+    (`base.py:104-119`).  Unknown names raise with available choices listed.
+    """
+    _import_builtins()
+
+    config = config or "random"
+    if isinstance(config, str):
+        name, kwargs = config, {}
+    elif isinstance(config, dict):
+        if len(config) != 1:
+            raise ValueError(f"Algorithm config must have exactly one key: {config}")
+        name, kwargs = next(iter(config.items()))
+        kwargs = dict(kwargs or {})
+    else:
+        raise TypeError(f"Bad algorithm config {config!r}")
+    if seed is not None:
+        kwargs.setdefault("seed", seed)
+    return algo_registry.create(name, space, **kwargs)

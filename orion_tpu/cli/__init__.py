@@ -1,0 +1,57 @@
+"""`orion-tpu` command-line interface.
+
+Capability parity: reference `src/orion/core/cli/__init__.py` + `cli/base.py`
+— subcommand modules are auto-discovered (any module in this package exposing
+``add_subparser``), global verbosity/version/debug flags, and common
+experiment argument groups shared across commands.
+"""
+
+import argparse
+import importlib
+import logging
+import pkgutil
+import sys
+
+import orion_tpu
+
+log = logging.getLogger(__name__)
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(
+        prog="orion-tpu",
+        description="TPU-native asynchronous hyperparameter optimization",
+    )
+    parser.add_argument(
+        "-V", "--version", action="version", version=f"orion-tpu {orion_tpu.__version__}"
+    )
+    parser.add_argument(
+        "-v",
+        "--verbose",
+        action="count",
+        default=0,
+        help="logging level: -v info, -vv debug",
+    )
+    subparsers = parser.add_subparsers(dest="command", metavar="COMMAND")
+
+    import orion_tpu.cli as cli_pkg
+
+    for module_info in sorted(pkgutil.iter_modules(cli_pkg.__path__), key=lambda m: m.name):
+        if module_info.name.startswith("_") or module_info.name == "base":
+            continue
+        module = importlib.import_module(f"orion_tpu.cli.{module_info.name}")
+        if hasattr(module, "add_subparser"):
+            module.add_subparser(subparsers)
+    return parser
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    level = {0: logging.WARNING, 1: logging.INFO}.get(args.verbose, logging.DEBUG)
+    logging.basicConfig(level=level, format="%(levelname)s %(name)s: %(message)s")
+    if not getattr(args, "func", None):
+        parser.print_help()
+        return 1
+    return args.func(args) or 0

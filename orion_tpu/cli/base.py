@@ -1,0 +1,113 @@
+"""Shared CLI argument groups and experiment bootstrapping.
+
+Capability parity: reference `src/orion/core/cli/base.py` — the common
+``-n/--name``, ``--version``, ``-c/--config``, ``--debug`` group plus the
+trailing ``user_args`` remainder, and the helper that turns parsed args into
+a built Experiment (storage setup -> prior extraction -> build/branch).
+"""
+
+import os
+
+import yaml
+
+from orion_tpu.config import resolve_config
+from orion_tpu.core.experiment import build_experiment
+from orion_tpu.io.cmdline import CommandLineParser
+from orion_tpu.storage.base import setup_storage
+from orion_tpu.utils.exceptions import NoConfigurationError
+
+
+def add_experiment_args(parser, with_user_args=True):
+    group = parser.add_argument_group("experiment")
+    group.add_argument("-n", "--name", help="experiment name")
+    group.add_argument("--exp-version", type=int, default=None, help="experiment version")
+    group.add_argument(
+        "-c", "--config", metavar="path", help="orion-tpu configuration file (yaml)"
+    )
+    group.add_argument(
+        "--debug", action="store_true", help="use an in-memory non-persistent storage"
+    )
+    group.add_argument(
+        "--storage-path", default=None, help="path of the pickled storage file"
+    )
+    group.add_argument(
+        "--manual-resolution",
+        action="store_true",
+        help="resolve branching conflicts interactively instead of automatically",
+    )
+    if with_user_args:
+        import argparse
+
+        parser.add_argument(
+            "user_args",
+            nargs=argparse.REMAINDER,
+            metavar="command",
+            help="user script and its arguments, with priors as name~'expr'",
+        )
+    return group
+
+
+def load_cli_config(args):
+    """Merge config sources: defaults < env < config file < cmdline."""
+    file_config = {}
+    if getattr(args, "config", None):
+        with open(args.config) as handle:
+            file_config = yaml.safe_load(handle) or {}
+    cmd_config = {
+        key: value
+        for key, value in {
+            "name": getattr(args, "name", None),
+            "version": getattr(args, "exp_version", None),
+            "max_trials": getattr(args, "max_trials", None),
+            "pool_size": getattr(args, "pool_size", None),
+            "working_dir": getattr(args, "working_dir", None),
+            "max_broken": getattr(args, "max_broken", None),
+        }.items()
+        if value is not None
+    }
+    storage_override = None
+    if getattr(args, "debug", False):
+        storage_override = {"type": "memory"}
+    elif getattr(args, "storage_path", None):
+        storage_override = {"type": "pickled", "path": args.storage_path}
+    return resolve_config(file_config, cmd_config, storage_override)
+
+
+def build_from_args(args, need_user_args=True):
+    """CLI args -> (experiment, cmdline_parser), with storage wired up."""
+    config = load_cli_config(args)
+    if not config.get("name"):
+        raise NoConfigurationError("an experiment name is required (-n/--name)")
+    storage = setup_storage(config["storage"], force=True)
+
+    parser = CommandLineParser(config_prefix=config.get("user_script_config", "config"))
+    user_args = list(getattr(args, "user_args", []) or [])
+    priors = parser.parse(user_args)
+
+    metadata = {"user_args": user_args, "parser_state": parser.state_dict()}
+    if user_args:
+        metadata["user_script"] = os.path.abspath(user_args[0])
+    experiment = build_experiment(
+        storage,
+        config["name"],
+        version=config.get("version"),
+        priors=priors or None,
+        metadata=metadata,
+        max_trials=config.get("max_trials"),
+        pool_size=config.get("pool_size"),
+        working_dir=config.get("working_dir"),
+        max_broken=config.get("max_broken"),
+        algorithms=config.get("algorithms"),
+        strategy=config.get("strategy"),
+        branch_config={"manual_resolution": getattr(args, "manual_resolution", False)},
+    )
+    # Resuming: rebuild the parser from the stored experiment metadata so the
+    # original template (and config file) is used even without user args.
+    if not user_args:
+        if experiment.metadata.get("parser_state"):
+            parser = CommandLineParser.from_state(experiment.metadata["parser_state"])
+        elif need_user_args:
+            raise NoConfigurationError(
+                "a user script command is required for a new experiment"
+            )
+    return experiment, parser

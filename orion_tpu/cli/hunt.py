@@ -1,0 +1,41 @@
+"""`orion-tpu hunt`: run the optimization loop.
+
+Capability parity: reference `src/orion/core/cli/hunt.py` — build/branch the
+experiment from args, then `workon` it.
+"""
+
+import sys
+
+from orion_tpu.cli.base import add_experiment_args, build_from_args
+from orion_tpu.core.worker import format_stats, workon
+from orion_tpu.utils.exceptions import BrokenExperiment
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser("hunt", help="run optimization")
+    add_experiment_args(parser)
+    group = parser.add_argument_group("worker")
+    group.add_argument("--max-trials", type=int, default=None, help="total completed-trial budget")
+    group.add_argument(
+        "--worker-trials",
+        type=int,
+        default=None,
+        help="trials this worker executes before exiting (default: unlimited)",
+    )
+    group.add_argument("--pool-size", type=int, default=None, help="suggestions per producer round")
+    group.add_argument("--working-dir", default=None, help="permanent trial working directory")
+    group.add_argument("--max-broken", type=int, default=None, help="broken-trial budget")
+    parser.set_defaults(func=main)
+    return parser
+
+
+def main(args):
+    experiment, parser = build_from_args(args)
+    experiment.instantiate()
+    try:
+        workon(experiment, parser, worker_trials=args.worker_trials)
+    except BrokenExperiment as exc:
+        print(f"Error: {exc}", file=sys.stderr)
+        return 1
+    print(format_stats(experiment))
+    return 0

@@ -1,0 +1,65 @@
+"""Layered configuration resolution.
+
+Capability parity: reference `src/orion/core/io/resolve_config.py` +
+`io/config.py` — precedence **defaults < environment < config file <
+command line** (reference `experiment_builder.py:13-88`), with the worker
+knobs (`heartbeat`, `max_broken`, `max_idle_time`) and storage selection
+(`ORION_DB_TYPE` / `ORION_DB_ADDRESS` env overrides) of the reference's
+global Configuration object.
+"""
+
+import os
+
+DEFAULTS = {
+    "name": None,
+    "version": None,
+    "max_trials": float("inf"),
+    "max_broken": 3,
+    "pool_size": 1,
+    "worker_trials": None,
+    "working_dir": None,
+    "algorithms": "random",
+    "strategy": "MaxParallelStrategy",
+    "heartbeat": 120.0,
+    "max_idle_time": 60.0,
+    "user_script_config": "config",
+    "storage": {"type": "pickled", "path": "orion_tpu_db.pkl"},
+}
+
+
+def _env_config():
+    out = {}
+    storage = {}
+    if os.getenv("ORION_DB_TYPE"):
+        storage["type"] = os.environ["ORION_DB_TYPE"]
+    if os.getenv("ORION_DB_ADDRESS"):
+        storage["path"] = os.environ["ORION_DB_ADDRESS"]
+    if storage:
+        out["storage"] = storage
+    for key in ("max_trials", "pool_size", "max_broken"):
+        env = os.getenv(f"ORION_{key.upper()}")
+        if env:
+            out[key] = type(DEFAULTS[key])(env) if DEFAULTS[key] is not None else env
+    return out
+
+
+def merge_configs(*configs):
+    """Deep merge, later wins; None values never override (reference
+    `resolve_config.py:195-246`)."""
+    out = {}
+    for config in configs:
+        for key, value in (config or {}).items():
+            if value is None:
+                continue
+            if isinstance(value, dict) and isinstance(out.get(key), dict):
+                out[key] = merge_configs(out[key], value)
+            else:
+                out[key] = value
+    return out
+
+
+def resolve_config(file_config=None, cmd_config=None, storage_override=None):
+    config = merge_configs(DEFAULTS, _env_config(), file_config, cmd_config)
+    if storage_override:
+        config["storage"] = storage_override
+    return config

@@ -1,0 +1,247 @@
+"""Experiment: the DB-backed facade every subsystem talks to.
+
+Capability parity: reference `src/orion/core/worker/experiment.py` — load by
+(name, version) with latest-version resolution, trial operations delegated to
+storage (atomic reservation + lost-trial sweep, registration with submit
+time, lies, completed updates), `is_done`/`is_broken` from DB counts, stats,
+and `configure()` with race-condition handling.  Branching/conflict logic
+lives in `orion_tpu.evc` and is invoked from the builder, not here.
+"""
+
+import logging
+import time
+
+from orion_tpu.algo.base import create_algo
+from orion_tpu.core.strategy import create_strategy
+from orion_tpu.core.trial import Trial
+from orion_tpu.space.dsl import build_space
+from orion_tpu.utils.exceptions import (
+    DuplicateKeyError,
+    FailedUpdate,
+    RaceCondition,
+)
+
+log = logging.getLogger(__name__)
+
+#: Worker-level defaults (reference `core/__init__.py:52-105`).
+DEFAULT_HEARTBEAT = 120.0
+DEFAULT_MAX_BROKEN = 3
+DEFAULT_MAX_IDLE_TIME = 60.0
+DEFAULT_POOL_SIZE = 1
+
+
+class Experiment:
+    """One named, versioned optimization run over a search space."""
+
+    def __init__(self, storage, config):
+        self._storage = storage
+        self.name = config["name"]
+        self.version = config.get("version", 1)
+        self._id = config.get("_id")
+        self.metadata = dict(config.get("metadata", {}))
+        self.max_trials = config.get("max_trials", float("inf"))
+        self.max_broken = config.get("max_broken", DEFAULT_MAX_BROKEN)
+        self.heartbeat = config.get("heartbeat", DEFAULT_HEARTBEAT)
+        self.pool_size = config.get("pool_size", DEFAULT_POOL_SIZE)
+        self.working_dir = config.get("working_dir")
+        self.algo_config = config.get("algorithms", "random")
+        self.strategy_config = config.get("strategy", "MaxParallelStrategy")
+        self.refers = dict(config.get("refers", {}))
+        self.priors = dict(config.get("priors") or config.get("metadata", {}).get("priors", {}))
+        self.space = build_space(self.priors) if self.priors else None
+        self.algorithm = None
+        self.strategy = None
+
+    # --- instantiation ------------------------------------------------------
+    def instantiate(self, seed=None):
+        """Build the algorithm + strategy from config (reference
+        `experiment.py:562-614`)."""
+        if self.space is None:
+            raise ValueError(f"Experiment {self.name} has no search space")
+        self.algorithm = create_algo(self.space, self.algo_config, seed=seed)
+        self.strategy = create_strategy(self.strategy_config)
+        return self
+
+    @property
+    def id(self):
+        return self._id
+
+    @property
+    def storage(self):
+        return self._storage
+
+    def configuration(self):
+        return {
+            "name": self.name,
+            "version": self.version,
+            "metadata": self.metadata,
+            "max_trials": self.max_trials,
+            "max_broken": self.max_broken,
+            "pool_size": self.pool_size,
+            "working_dir": self.working_dir,
+            "algorithms": self.algo_config,
+            "strategy": self.strategy_config,
+            "priors": self.priors,
+            "refers": self.refers,
+        }
+
+    # --- trial operations ---------------------------------------------------
+    def fix_lost_trials(self):
+        """Sweep reserved trials with stale heartbeats back to reservable
+        (the elastic-recovery story; reference `experiment.py:217-232`)."""
+        for trial in self._storage.fetch_lost_trials(self._id, self.heartbeat):
+            try:
+                self._storage.set_trial_status(trial, "interrupted", was="reserved")
+                log.info("Recovered lost trial %s", trial.id)
+            except FailedUpdate:
+                pass  # another worker got there first — fine
+
+    def reserve_trial(self):
+        self.fix_lost_trials()
+        trial = self._storage.reserve_trial(self._id)
+        if trial is not None:
+            trial.working_dir = self.working_dir
+        return trial
+
+    def register_trial(self, trial, parents=()):
+        trial.experiment = self._id
+        trial.parents = list(parents)
+        trial.submit_time = time.time()
+        self._storage.register_trial(trial)
+        return trial
+
+    def register_lie(self, trial):
+        trial.experiment = self._id
+        self._storage.register_lie(trial)
+        return trial
+
+    def update_completed_trial(self, trial, results):
+        return self._storage.update_completed_trial(trial, results)
+
+    def set_trial_status(self, trial, status, was=None):
+        return self._storage.set_trial_status(trial, status, was=was)
+
+    def update_heartbeat(self, trial):
+        self._storage.update_heartbeat(trial)
+
+    def fetch_trials(self, with_evc_tree=False):
+        if with_evc_tree and self.refers.get("root_id"):
+            from orion_tpu.evc.experiment import fetch_tree_trials
+
+            return fetch_tree_trials(self)
+        return self._storage.fetch_trials(uid=self._id)
+
+    def fetch_trials_by_status(self, status):
+        return self._storage.fetch_trials_by_status(self._id, status)
+
+    def fetch_lies(self):
+        return self._storage.fetch_lies(self._id)
+
+    def fetch_noncompleted_trials(self):
+        return self._storage.fetch_noncompleted_trials(self._id)
+
+    # --- termination --------------------------------------------------------
+    @property
+    def is_done(self):
+        """Completed-trial budget reached, or the algorithm says so."""
+        if self._storage.count_completed_trials(self._id) >= self.max_trials:
+            return True
+        return bool(self.algorithm is not None and self.algorithm.is_done)
+
+    @property
+    def is_broken(self):
+        return self._storage.count_broken_trials(self._id) >= self.max_broken
+
+    # --- stats --------------------------------------------------------------
+    def stats(self):
+        """Best trial + counts + duration (reference `experiment.py:419-467`)."""
+        completed = self.fetch_trials_by_status("completed")
+        out = {
+            "trials_completed": len(completed),
+            "best_trials_id": None,
+            "best_evaluation": None,
+            "start_time": self.metadata.get("timestamp"),
+            "finish_time": None,
+            "duration": None,
+        }
+        best = None
+        finish = None
+        for trial in completed:
+            obj = trial.objective
+            if obj is None:
+                continue
+            if best is None or obj.value < best.objective.value:
+                best = trial
+            if trial.end_time is not None:
+                finish = max(finish or trial.end_time, trial.end_time)
+        if best is not None:
+            out["best_trials_id"] = best.id
+            out["best_evaluation"] = best.objective.value
+            out["best_params"] = dict(best.params)
+        if finish is not None:
+            out["finish_time"] = finish
+            if out["start_time"] is not None:
+                out["duration"] = finish - out["start_time"]
+        return out
+
+
+def build_experiment(
+    storage,
+    name,
+    version=None,
+    priors=None,
+    branch_config=None,
+    **config,
+):
+    """Create-or-resume an experiment (reference `experiment_builder.py:224-288`).
+
+    Resolution: fetch latest (or requested) version from storage; if absent,
+    create version 1 with the given config.  If present and the new config
+    conflicts with the stored one, delegate to EVC branching (a version bump
+    child experiment) — `orion_tpu.evc.builder.branch_experiment`.
+    Races on concurrent creation retry once (RaceCondition semantics).
+    """
+    config = {k: v for k, v in config.items() if v is not None}
+    for attempt in range(2):
+        existing = _fetch_config(storage, name, version)
+        if existing is None:
+            full = {
+                "name": name,
+                "version": version or 1,
+                "priors": dict(priors or {}),
+                "metadata": {"timestamp": time.time(), **config.pop("metadata", {})},
+                **config,
+            }
+            full["_id"] = full.get("_id") or Trial.compute_id(name, {"v": full["version"]})
+            try:
+                created = storage.create_experiment(full)
+                return Experiment(storage, created)
+            except DuplicateKeyError:
+                if attempt:
+                    raise RaceCondition(
+                        f"lost creation race for experiment {name!r} twice"
+                    )
+                continue  # someone else created it — reload
+        # Resume path.
+        exp = Experiment(storage, existing)
+        if priors and dict(priors) != exp.priors:
+            from orion_tpu.evc.builder import branch_experiment
+
+            return branch_experiment(
+                storage, exp, dict(priors), branch_config=branch_config, **config
+            )
+        for key in ("max_trials", "pool_size", "working_dir", "max_broken"):
+            if key in config and config[key] is not None:
+                setattr(exp, key, config[key])
+        return exp
+    raise RaceCondition(f"could not build experiment {name!r}")
+
+
+def _fetch_config(storage, name, version=None):
+    query = {"name": name}
+    if version is not None:
+        query["version"] = version
+    docs = storage.fetch_experiments(query)
+    if not docs:
+        return None
+    return max(docs, key=lambda d: d.get("version", 1))

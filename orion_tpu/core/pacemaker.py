@@ -1,0 +1,34 @@
+"""Heartbeat thread for running trials.
+
+Capability parity: reference `src/orion/core/worker/trial_pacemaker.py` —
+a daemon thread bumping the trial's heartbeat every `wait_time` seconds while
+it stays reserved; stops itself when the trial reaches a stopped status or
+the update fails (meaning another actor transitioned it).
+"""
+
+import threading
+
+from orion_tpu.utils.exceptions import FailedUpdate
+
+DEFAULT_WAIT_TIME = 60.0
+
+
+class TrialPacemaker(threading.Thread):
+    def __init__(self, storage, trial, wait_time=DEFAULT_WAIT_TIME):
+        super().__init__(daemon=True)
+        self.storage = storage
+        self.trial = trial
+        self.wait_time = wait_time
+        self._stop_event = threading.Event()
+
+    def stop(self):
+        self._stop_event.set()
+
+    def run(self):
+        while not self._stop_event.wait(self.wait_time):
+            try:
+                self.storage.update_heartbeat(self.trial)
+            except FailedUpdate:
+                break  # trial no longer reserved — our work here is done
+            except Exception:  # pragma: no cover - storage hiccup; retry next beat
+                continue

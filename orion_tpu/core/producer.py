@@ -1,0 +1,125 @@
+"""Producer: turn algorithm suggestions into registered trials.
+
+Capability parity: reference `src/orion/core/worker/producer.py` — observe
+completed trials in the real algorithm + strategy; build a *naive* copy that
+additionally observes fantasized results ("lies") for incomplete trials;
+suggest from the naive copy so concurrent suggestion stays diverse; register
+trials with lineage parents; gaussian-jitter backoff on duplicate points and
+a `max_idle_time` guard against algorithms that stop producing new points.
+"""
+
+import copy
+import logging
+import random as _random
+import time
+
+from orion_tpu.core.trial import Result, Trial
+from orion_tpu.utils.exceptions import DuplicateKeyError, SampleTimeout
+
+log = logging.getLogger(__name__)
+
+
+class Producer:
+    def __init__(self, experiment, max_idle_time=60.0):
+        if experiment.algorithm is None:
+            raise RuntimeError("Experiment not instantiated (call instantiate())")
+        self.experiment = experiment
+        self.algorithm = experiment.algorithm
+        self.strategy = experiment.strategy
+        self.max_idle_time = max_idle_time
+        self.naive_algorithm = None
+        self._observed_ids = set()  # replaces reference TrialsHistory dedup
+        self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
+        self.failure_count = 0
+
+    # --- observation --------------------------------------------------------
+    def update(self):
+        """Sync algorithm state with storage (reference `producer.py:103-132`)."""
+        trials = self.experiment.fetch_trials()
+        completed = [t for t in trials if t.status == "completed" and t.objective]
+        incomplete = [t for t in trials if not t.is_stopped]
+        self._update_algorithm(completed)
+        self._update_naive_algorithm(incomplete)
+
+    def _update_algorithm(self, completed):
+        fresh = [t for t in completed if t.id not in self._observed_ids]
+        if fresh:
+            params = [t.params for t in fresh]
+            results = [_trial_results(t) for t in fresh]
+            self.algorithm.observe(params, results)
+            self.strategy.observe(params, results)
+            for t in fresh:
+                self._observed_ids.add(t.id)
+            self._leaf_ids = [t.id for t in fresh]
+
+    def _update_naive_algorithm(self, incomplete):
+        """Naive algo = deepcopy of real + lies for in-flight trials
+        (reference `producer.py:159-174`)."""
+        self.naive_algorithm = copy.deepcopy(self.algorithm)
+        lying_trials = self._produce_lies(incomplete)
+        if lying_trials:
+            params = [t.params for t in lying_trials]
+            results = [{"objective": t.lie.value} for t in lying_trials]
+            self.naive_algorithm.observe(params, results)
+
+    def _produce_lies(self, incomplete):
+        lying = []
+        for trial in incomplete:
+            lie = self.strategy.lie(trial)
+            if lie is None or lie.value is None:
+                continue
+            lying_trial = Trial(
+                experiment=trial.experiment,
+                params=dict(trial.params),
+                results=[Result(lie.name, "lie", lie.value)],
+            )
+            try:
+                self.experiment.register_lie(lying_trial)
+            except DuplicateKeyError:
+                pass  # lie already registered in a previous round
+            lying.append(lying_trial)
+        return lying
+
+    # --- production ---------------------------------------------------------
+    def produce(self, pool_size=None):
+        """Register `pool_size` new trials (reference `producer.py:69-101`)."""
+        pool_size = pool_size or self.experiment.pool_size
+        registered = 0
+        start = time.time()
+        while registered < pool_size:
+            if time.time() - start > self.max_idle_time:
+                raise SampleTimeout(
+                    f"algorithm produced no new unique point in {self.max_idle_time}s"
+                )
+            suggested = self.naive_algorithm.suggest(pool_size - registered)
+            if suggested is None:
+                log.debug("algorithm opted out of suggesting; backing off")
+                self.backoff()
+                continue
+            # Sync real algo RNG/state forward (reference `producer.py:82-84`).
+            self.algorithm.set_state(self.naive_algorithm.state_dict())
+            for params in suggested:
+                trial = Trial(params=params)
+                try:
+                    self.experiment.register_trial(trial, parents=self._leaf_ids)
+                    registered += 1
+                except DuplicateKeyError:
+                    log.debug("duplicate suggestion %s; backing off", trial.id)
+                    self.backoff()
+        return registered
+
+    def backoff(self):
+        """Re-sync with storage + jittered sleep (reference `producer.py:61-67`)."""
+        self.update()
+        sleep = max(0.0, _random.gauss(0.01 * (1 + self.failure_count), 0.005))
+        time.sleep(min(sleep, 0.5))
+        self.failure_count += 1
+
+
+def _trial_results(trial):
+    out = {"objective": trial.objective.value if trial.objective else None}
+    if trial.gradient is not None:
+        out["gradient"] = trial.gradient.value
+    if trial.constraints:
+        out["constraint"] = [c.value for c in trial.constraints]
+    return out

@@ -48,7 +48,9 @@ def _canonical(value):
     except ImportError:  # pragma: no cover
         pass
     if isinstance(value, (list, tuple)):
-        return repr([_canonical(v) for v in value])
+        # Keep list/tuple distinguishable while canonicalizing elements.
+        inner = ",".join(_canonical(v) for v in value)
+        return ("[%s]" if isinstance(value, list) else "(%s)") % inner
     return repr(value)
 
 

@@ -1,0 +1,93 @@
+"""The worker main loop.
+
+Capability parity: reference `src/orion/core/worker/__init__.py` — `workon`
+creates a Producer and Consumer and loops `worker_trials` times (infinite by
+default): stop when the experiment is done or broken; reserve a trial
+(producing new ones when the queue is dry); consume it; report stats at the
+end.  Many workers running this loop against one shared storage is the
+framework's data-parallel execution model over DCN; on-device parallelism
+lives inside each algorithm's jitted suggest step.
+"""
+
+import io
+import logging
+
+from orion_tpu.core.consumer import Consumer
+from orion_tpu.core.producer import Producer
+from orion_tpu.utils.exceptions import BrokenExperiment, SampleTimeout, WaitingForTrials
+
+log = logging.getLogger(__name__)
+
+
+def reserve_trial(experiment, producer, _depth=0):
+    """Reserve a trial, producing a fresh batch when none is pending
+    (reference `worker/__init__.py:24-39`)."""
+    trial = experiment.reserve_trial()
+    if trial is not None:
+        return trial
+    if _depth >= 10:
+        raise WaitingForTrials(
+            "no trial could be reserved after repeated production rounds"
+        )
+    log.debug("no pending trials; producing a new batch")
+    producer.update()
+    producer.produce()
+    return reserve_trial(experiment, producer, _depth=_depth + 1)
+
+
+def workon(
+    experiment,
+    cmdline_parser,
+    worker_trials=None,
+    max_idle_time=60.0,
+    heartbeat_interval=60.0,
+    on_error=None,
+):
+    """Run the optimization loop for up to `worker_trials` trials."""
+    if worker_trials is None or worker_trials < 0:
+        worker_trials = float("inf")
+    producer = Producer(experiment, max_idle_time=max_idle_time)
+    consumer = Consumer(
+        experiment, cmdline_parser, heartbeat_interval=heartbeat_interval
+    )
+
+    iterations = 0
+    while iterations < worker_trials:
+        if experiment.is_broken:
+            log.error(
+                "Experiment %s is broken (>= %s broken trials); stopping.",
+                experiment.name,
+                experiment.max_broken,
+            )
+            raise BrokenExperiment(f"experiment {experiment.name} has too many broken trials")
+        if experiment.is_done:
+            log.info("Experiment %s is done.", experiment.name)
+            break
+        try:
+            trial = reserve_trial(experiment, producer)
+        except (SampleTimeout, WaitingForTrials):
+            if experiment.is_done:
+                break
+            raise
+        log.debug("Consuming trial %s", trial.id)
+        success = consumer.consume(trial)
+        if not success and on_error is not None:
+            on_error(trial)
+        iterations += 1
+    return iterations
+
+
+def format_stats(experiment):
+    """Human-readable end-of-run summary (reference `worker/__init__.py:66-88`)."""
+    stats = experiment.stats()
+    out = io.StringIO()
+    out.write("RESULTS\n=======\n")
+    out.write(f"experiment: {experiment.name} (v{experiment.version})\n")
+    out.write(f"trials completed: {stats['trials_completed']}\n")
+    if stats.get("best_evaluation") is not None:
+        out.write(f"best objective: {stats['best_evaluation']}\n")
+        out.write(f"best trial: {stats['best_trials_id']}\n")
+        out.write("best params:\n")
+        for name, value in sorted(stats.get("best_params", {}).items()):
+            out.write(f"  {name}: {value}\n")
+    return out.getvalue()

@@ -1,0 +1,1 @@
+"""Host-side IO: user-commandline parsing, config converters, templating."""

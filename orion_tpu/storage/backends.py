@@ -9,6 +9,7 @@ one node — the same guarantee the reference offers).
 """
 
 import contextlib
+import errno
 import fcntl
 import os
 import pickle
@@ -34,7 +35,9 @@ def _file_lock(lock_path, timeout=DEFAULT_LOCK_TIMEOUT, poll=0.01):
             try:
                 fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
                 break
-            except OSError:
+            except OSError as exc:
+                if exc.errno not in (errno.EAGAIN, errno.EACCES, errno.EWOULDBLOCK):
+                    raise  # real flock failure (e.g. ENOLCK) — don't mask as timeout
                 if time.monotonic() >= deadline:
                     raise LockAcquisitionTimeout(
                         f"could not lock {lock_path} within {timeout}s"
@@ -93,6 +96,13 @@ class PickledDB:
     def ensure_index(self, collection, keys, unique=False):
         with self._locked() as db:
             db.ensure_index(collection, keys, unique=unique)
+
+    def ensure_indexes(self, specs):
+        """All index definitions in ONE lock/load/dump cycle (worker startup
+        happens per process; five separate cycles would rewrite the whole DB
+        file five times under the shared lock)."""
+        with self._locked() as db:
+            db.ensure_indexes(specs)
 
     def index_information(self, collection):
         with self._locked(write=False) as db:

@@ -88,12 +88,16 @@ class DocumentStorage(BaseStorage):
         return self._db
 
     def _setup_indexes(self):
-        # Reference `legacy.py:70-88`.
-        self._db.ensure_index("experiments", ["name", "version"], unique=True)
-        self._db.ensure_index("trials", ["experiment"])
-        self._db.ensure_index("trials", ["status"])
-        self._db.ensure_index("trials", ["experiment", "status"])
-        self._db.ensure_index("lying_trials", ["experiment"])
+        # Reference `legacy.py:70-88`; batched into one backend write cycle.
+        self._db.ensure_indexes(
+            [
+                ("experiments", ["name", "version"], True),
+                ("trials", ["experiment"], False),
+                ("trials", ["status"], False),
+                ("trials", ["experiment", "status"], False),
+                ("lying_trials", ["experiment"], False),
+            ]
+        )
 
     # --- experiments --------------------------------------------------------
     def create_experiment(self, config):

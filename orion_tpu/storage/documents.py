@@ -14,7 +14,7 @@ import copy
 import threading
 
 from orion_tpu.utils.exceptions import DuplicateKeyError
-from orion_tpu.utils.flatten import flatten, unflatten
+from orion_tpu.utils.flatten import flatten
 
 _OPS = {
     "$ne": lambda doc_val, qv: doc_val != qv,
@@ -52,24 +52,45 @@ def _matches(flat_doc, nested_doc, query):
     return True
 
 
+def _get_path(doc, dotted):
+    """Resolve a dotted path against nested dicts; literal keys win first."""
+    if dotted in doc:
+        return True, doc[dotted]
+    node = doc
+    for part in dotted.split("."):
+        if isinstance(node, dict) and part in node:
+            node = node[part]
+        else:
+            return False, None
+    return True, node
+
+
+def _set_path(doc, dotted, value):
+    parts = dotted.split(".")
+    node = doc
+    for part in parts[:-1]:
+        node = node.setdefault(part, {})
+    node[parts[-1]] = value
+
+
 def _project(nested_doc, projection):
+    """Inclusion-style projection walking dotted paths directly — documents
+    with literal "." in keys are returned byte-identical, never restructured."""
     if not projection:
         return copy.deepcopy(nested_doc)
     keep_id = projection.get("_id", 1)
     selected = {k for k, v in projection.items() if v and k != "_id"}
-    if not selected:  # exclusion-style projection not needed by the framework
-        out = copy.deepcopy(nested_doc)
-        if not keep_id:
-            out.pop("_id", None)
-        return out
-    flat = flatten(nested_doc)
     out = {}
-    for key, value in flat.items():
-        if any(key == s or key.startswith(s + ".") for s in selected):
-            out[key] = copy.deepcopy(value)
+    for key in selected:
+        found, value = _get_path(nested_doc, key)
+        if found:
+            if key in nested_doc:
+                out[key] = copy.deepcopy(value)
+            else:
+                _set_path(out, key, copy.deepcopy(value))
     if keep_id and "_id" in nested_doc:
         out["_id"] = nested_doc["_id"]
-    return unflatten(out)
+    return out
 
 
 class Collection:
@@ -223,6 +244,12 @@ class MemoryDB:
     def ensure_index(self, collection, keys, unique=False):
         with self._lock:
             self._col(collection).ensure_index(keys, unique=unique)
+
+    def ensure_indexes(self, specs):
+        """Batched index setup: [(collection, keys, unique), ...] in one pass."""
+        with self._lock:
+            for collection, keys, unique in specs:
+                self._col(collection).ensure_index(keys, unique=unique)
 
     def index_information(self, collection):
         with self._lock:

@@ -48,3 +48,15 @@ class BrokenExperiment(OrionTPUError):
 
 class InvalidResult(OrionTPUError):
     """User script reported malformed results."""
+
+
+class SampleTimeout(OrionTPUError):
+    """Algorithm failed to sample a new unique point within max_idle_time."""
+
+
+class WaitingForTrials(OrionTPUError):
+    """No trial could be reserved right now."""
+
+
+class MissingResultFile(OrionTPUError):
+    """User script exited 0 but never reported results."""

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Quadratic black box used by functional tests.
+
+Parity model: reference tests/functional/demo/black_box.py — known optimum
+f(34.56) = 23.4, reports objective + gradient, asserts the worker env
+contract is present.
+"""
+
+import argparse
+import os
+
+from orion_tpu.client import report_results
+
+
+def main():
+    assert os.environ.get("ORION_TRIAL_ID"), "env contract missing: ORION_TRIAL_ID"
+    assert os.environ.get("ORION_EXPERIMENT_NAME"), "env contract missing"
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-x", type=float, required=True)
+    args = parser.parse_args()
+    y = (args.x - 34.56) ** 2 + 23.4
+    report_results(
+        [
+            {"name": "objective", "type": "objective", "value": y},
+            {"name": "gradient", "type": "gradient", "value": [2 * (args.x - 34.56)]},
+        ]
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,6 @@
+#!/usr/bin/env python
+"""Black box that always crashes (parity: reference broken_box.py)."""
+
+import sys
+
+sys.exit(1)

@@ -1,0 +1,124 @@
+"""Whole-system scenarios through the real CLI.
+
+Parity model: reference tests/functional/demo/test_demo.py — hunts through
+`orion_tpu.cli.main([...])` against a hermetic file DB, covering: default
+algorithm run, resume, broken-script budget, two concurrent workers on one
+DB, and the env/results contract (asserted inside black_box.py).
+"""
+
+import multiprocessing
+import os
+import subprocess
+import sys
+
+import pytest
+
+from orion_tpu.cli import main as cli_main
+from orion_tpu.storage import create_storage
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BLACK_BOX = os.path.join(HERE, "black_box.py")
+BROKEN_BOX = os.path.join(HERE, "broken_box.py")
+
+
+def storage_args(tmp_path):
+    return ["--storage-path", str(tmp_path / "db.pkl")]
+
+
+def test_hunt_random_end_to_end(tmp_path):
+    rc = cli_main(
+        ["hunt", "-n", "demo", *storage_args(tmp_path),
+         "--max-trials", "10", "--worker-trials", "10",
+         BLACK_BOX, "-x~uniform(-50,50)"]
+    )
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exps = storage.fetch_experiments({"name": "demo"})
+    assert len(exps) == 1
+    trials = storage.fetch_trials(uid=exps[0]["_id"])
+    completed = [t for t in trials if t.status == "completed"]
+    assert len(completed) == 10
+    for t in completed:
+        assert t.objective is not None
+        assert "/x" in t.params
+        assert -50 <= t.params["/x"] <= 50
+
+
+def test_hunt_resume_continues_same_experiment(tmp_path):
+    args = ["hunt", "-n", "resume-exp", *storage_args(tmp_path), "--max-trials", "6"]
+    cli_main(args + ["--worker-trials", "3", BLACK_BOX, "-x~uniform(-50,50)"])
+    # Resume WITHOUT user args: parser template comes from stored metadata.
+    rc = cli_main(args + ["--worker-trials", "3"])
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exp = storage.fetch_experiments({"name": "resume-exp"})[0]
+    completed = [
+        t for t in storage.fetch_trials(uid=exp["_id"]) if t.status == "completed"
+    ]
+    assert len(completed) == 6
+
+
+def test_broken_script_aborts_after_max_broken(tmp_path):
+    rc = cli_main(
+        ["hunt", "-n", "broken", *storage_args(tmp_path),
+         "--max-trials", "10", "--max-broken", "2", "--worker-trials", "10",
+         BROKEN_BOX, "-x~uniform(-50,50)"]
+    )
+    assert rc == 1
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exp = storage.fetch_experiments({"name": "broken"})[0]
+    broken = [t for t in storage.fetch_trials(uid=exp["_id"]) if t.status == "broken"]
+    assert len(broken) == 2
+
+
+def test_init_only_registers_without_running(tmp_path):
+    rc = cli_main(
+        ["init-only", "-n", "init-exp", *storage_args(tmp_path),
+         BLACK_BOX, "-x~uniform(-50,50)"]
+    )
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exp = storage.fetch_experiments({"name": "init-exp"})[0]
+    assert exp["priors"] == {"/x": "uniform(-50,50)"}
+    assert storage.fetch_trials(uid=exp["_id"]) == []
+
+
+def _run_worker(db_path, name):
+    from orion_tpu.cli import main as _main
+
+    _main(
+        ["hunt", "-n", name, "--storage-path", db_path,
+         "--max-trials", "10", "--worker-trials", "10",
+         BLACK_BOX, "-x~uniform(-50,50)"]
+    )
+
+
+def test_two_workers_one_db(tmp_path):
+    """Parity: reference test_demo.py:149 (two workers via multiprocessing)."""
+    db_path = str(tmp_path / "db.pkl")
+    ctx = multiprocessing.get_context("spawn")
+    workers = [
+        ctx.Process(target=_run_worker, args=(db_path, "pair")) for _ in range(2)
+    ]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=240)
+        assert w.exitcode == 0
+    storage = create_storage({"type": "pickled", "path": db_path})
+    exps = storage.fetch_experiments({"name": "pair"})
+    assert len(exps) == 1  # creation race resolved to a single experiment
+    completed = [
+        t for t in storage.fetch_trials(uid=exps[0]["_id"]) if t.status == "completed"
+    ]
+    assert len(completed) >= 10
+    assert len({t.id for t in completed}) == len(completed)
+
+
+def test_console_entrypoint_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "orion_tpu.cli", "--version"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0
+    assert "orion-tpu" in out.stdout

@@ -257,3 +257,12 @@ def test_set_trial_status_guards_by_default(storage):
     storage.set_trial_status(trial, "reserved")  # guard = in-memory "new"
     with pytest.raises(FailedUpdate):
         storage.set_trial_status(other_view, "completed")  # stale view: still "new"
+
+
+def test_projection_preserves_dotted_keys_and_id_only():
+    db = MemoryDB()
+    db.write("c", {"_id": "t", "params": {"opt.lr": 1}, "other": 2})
+    out = db.read("c", projection={"params": 1})
+    assert out[0]["params"] == {"opt.lr": 1}
+    only_id = db.read("c", projection={"_id": 1})
+    assert only_id == [{"_id": "t"}]

@@ -93,3 +93,10 @@ def test_id_distinguishes_large_arrays():
     # and is stable across numpy print options
     with np.printoptions(threshold=5):
         assert Trial(experiment="e", params={"w": a}).id == t1.id
+
+
+def test_id_distinguishes_tuple_from_list():
+    assert (
+        Trial(experiment="e", params={"x": (1, 2)}).id
+        != Trial(experiment="e", params={"x": [1, 2]}).id
+    )
