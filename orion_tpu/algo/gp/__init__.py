@@ -1,0 +1,13 @@
+"""Device-resident Gaussian-process machinery for the batched BO engine.
+
+No counterpart exists in the reference (Oríon v0.1.7 ships only random search
+and ASHA); this package is the TPU-native optimizer core that BASELINE.json's
+north star specifies: GP posterior (Cholesky), marginal-likelihood fitting,
+and vmapped EI/UCB/Thompson acquisitions — all jitted, static-shape, and
+HBM-resident.
+"""
+
+from orion_tpu.algo.gp.gp import GPState, fit_gp, posterior
+from orion_tpu.algo.gp.kernels import kernel_matrix
+
+__all__ = ["GPState", "fit_gp", "posterior", "kernel_matrix"]

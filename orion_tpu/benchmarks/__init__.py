@@ -1,0 +1,11 @@
+"""Analytic benchmark functions + harness (BASELINE.md configs)."""
+
+from orion_tpu.benchmarks.functions import (
+    ackley,
+    branin,
+    hartmann6,
+    rosenbrock,
+    BENCHMARKS,
+)
+
+__all__ = ["ackley", "branin", "hartmann6", "rosenbrock", "BENCHMARKS"]

@@ -83,6 +83,15 @@ def build_from_args(args, need_user_args=True):
     parser = CommandLineParser(config_prefix=config.get("user_script_config", "config"))
     user_args = list(getattr(args, "user_args", []) or [])
     priors = parser.parse(user_args)
+    if need_user_args and not user_args:
+        # Only an existing experiment (with a stored command template) can be
+        # resumed without a script; check BEFORE build_experiment would
+        # persist an empty, priors-less experiment.
+        existing = storage.fetch_experiments({"name": config["name"]})
+        if not existing:
+            raise NoConfigurationError(
+                "a user script command is required for a new experiment"
+            )
 
     metadata = {"user_args": user_args, "parser_state": parser.state_dict()}
     if user_args:
@@ -104,10 +113,12 @@ def build_from_args(args, need_user_args=True):
     # Resuming: rebuild the parser from the stored experiment metadata so the
     # original template (and config file) is used even without user args.
     if not user_args:
-        if experiment.metadata.get("parser_state"):
-            parser = CommandLineParser.from_state(experiment.metadata["parser_state"])
+        state = experiment.metadata.get("parser_state")
+        if state and (state.get("template") or state.get("priors")):
+            parser = CommandLineParser.from_state(state)
         elif need_user_args:
             raise NoConfigurationError(
-                "a user script command is required for a new experiment"
+                f"experiment {experiment.name!r} has no stored command to resume; "
+                "provide the user script on the command line"
             )
     return experiment, parser

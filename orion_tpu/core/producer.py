@@ -96,8 +96,11 @@ class Producer:
                 log.debug("algorithm opted out of suggesting; backing off")
                 self.backoff()
                 continue
-            # Sync real algo RNG/state forward (reference `producer.py:82-84`).
-            self.algorithm.set_state(self.naive_algorithm.state_dict())
+            # Advance ONLY the real algo's RNG stream, never its full state:
+            # the naive copy has observed fantasy lies, and syncing its whole
+            # state_dict would permanently inject those rows into the real
+            # algorithm (compounding every round).
+            self.algorithm.rng_key = self.naive_algorithm.rng_key
             for params in suggested:
                 trial = Trial(params=params)
                 try:

@@ -14,10 +14,6 @@ import os
 import re
 
 from orion_tpu.io.convert import infer_converter
-from orion_tpu.space.dsl import split_marker
-
-# Reference regex `orion_cmdline_parser.py:88`.
-PRIOR_RE = re.compile(r"(.+)~([\+\-\>]?.+)", re.DOTALL)
 
 
 class CommandLineParser:
@@ -104,7 +100,6 @@ class CommandLineParser:
             self.template.append(token)
 
     def _add_prior(self, ns, expr, flag=None, eq=False):
-        marker, _clean = split_marker(expr)
         if ns in self.priors:
             raise ValueError(f"Duplicate prior for {ns}")
         self.priors[ns] = expr

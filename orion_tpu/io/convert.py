@@ -12,8 +12,6 @@ import re
 
 import yaml
 
-from orion_tpu.utils.flatten import unflatten
-
 
 def _flatten_ns(nested, prefix=""):
     """Flatten nested config into /-namespaced keys (reference convention)."""
@@ -28,7 +26,16 @@ def _flatten_ns(nested, prefix=""):
 
 
 def _unflatten_ns(flat):
-    return unflatten({k.lstrip("/").replace("/", "."): v for k, v in flat.items()})
+    # Split on "/" directly — keys containing a literal "." (e.g. "opt.lr")
+    # must survive the round trip unrestructured.
+    out = {}
+    for key, value in flat.items():
+        parts = key.lstrip("/").split("/")
+        node = out
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return out
 
 
 class YAMLConverter:

@@ -1,0 +1,40 @@
+"""Device-mesh parallelism for the on-device optimizer core.
+
+The reference's only parallelism is N worker processes around a shared DB
+(SURVEY.md §2.9); that layer survives over DCN.  *This* layer is the
+TPU-native one the reference could not have: inside a single suggest step,
+candidate evaluation is sharded across a `jax.sharding.Mesh` so acquisition
+over tens of thousands of candidates rides ICI collectives under one jit.
+
+With sharded inputs, XLA's SPMD partitioner inserts the collectives
+(the top-k/argmax reductions become all-gathers over the candidate axis);
+no hand-written pmap plumbing needed.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+CANDIDATE_AXIS = "candidates"
+
+
+def device_mesh(n_devices=None, axis_name=CANDIDATE_AXIS):
+    """1-D mesh over available devices (candidate/data parallel)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def candidate_sharding(mesh, axis_name=CANDIDATE_AXIS):
+    """Shard an (m, d) candidate matrix along m; d replicated."""
+    return NamedSharding(mesh, PartitionSpec(axis_name, None))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_candidates(candidates, mesh, axis_name=CANDIDATE_AXIS):
+    """Place candidates sharded over the mesh (no-op on a 1-device mesh)."""
+    return jax.device_put(candidates, candidate_sharding(mesh, axis_name))

@@ -7,10 +7,21 @@ vars must be set before the first `import jax` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run hermetically on a virtual 8-device CPU mesh.  The TPU image both
+# pre-sets JAX_PLATFORMS=axon AND pre-imports jax from sitecustomize, so env
+# vars are already captured — the platform must be forced through jax.config
+# before the first backend initialization.  XLA_FLAGS is still read from the
+# environment at init time, so the device-count flag works via os.environ.
+# Set ORION_TPU_TEST_PLATFORM=axon to run the suite on real hardware instead.
+_platform = os.environ.get("ORION_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", _platform)
 
 import numpy as np
 import pytest

@@ -122,3 +122,13 @@ def test_console_entrypoint_runs():
     )
     assert out.returncode == 0
     assert "orion-tpu" in out.stdout
+
+
+def test_hunt_without_script_on_new_experiment_fails_cleanly(tmp_path):
+    from orion_tpu.utils.exceptions import NoConfigurationError
+
+    with pytest.raises(NoConfigurationError):
+        cli_main(["hunt", "-n", "ghost", *storage_args(tmp_path), "--worker-trials", "1"])
+    # Nothing must have been persisted: the correct follow-up run starts clean.
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    assert storage.fetch_experiments({"name": "ghost"}) == []

@@ -1,0 +1,48 @@
+"""Device-mesh tests: these REQUIRE the 8-device virtual CPU mesh, so they
+also guard the conftest platform forcing."""
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.parallel import candidate_sharding, device_mesh, shard_candidates
+
+
+def test_conftest_gives_eight_cpu_devices():
+    assert len(jax.devices()) == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_candidates_shard_over_mesh():
+    mesh = device_mesh(8)
+    c = np.arange(64 * 3, dtype=np.float32).reshape(64, 3)
+    sharded = shard_candidates(c, mesh)
+    assert sharded.sharding == candidate_sharding(mesh)
+    assert len(sharded.sharding.device_set) == 8
+    np.testing.assert_array_equal(np.asarray(sharded), c)
+
+
+def test_graft_dryrun_multichip():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(__file__), "..", "..", "__graft_entry__.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    module.dryrun_multichip(8)
+
+
+def test_graft_entry_single_chip_jit():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry2", os.path.join(os.path.dirname(__file__), "..", "..", "__graft_entry__.py")
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    fn, args = module.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 4)
