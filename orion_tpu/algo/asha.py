@@ -1,0 +1,249 @@
+"""ASHA — Asynchronous Successive Halving.
+
+Capability parity: reference `src/orion/algo/asha.py` — brackets of rungs
+with geometric budgets; `suggest` first tries to promote the top
+1/reduction_factor of a filled rung to the next rung, else samples a new
+point at the bracket's bottom-rung fidelity (bracket chosen by softmax over
+negative rung occupancy); points dedup by hash of their non-fidelity params;
+`observe` records objectives into rungs; done when the top rungs are filled.
+
+TPU split: rung bookkeeping is inherently sequential, pointer-chasing host
+logic and stays host-side (as in the reference); *sampling* new points is the
+device path — one jitted uniform draw through the Space codec, so an ASHA
+sweep at q=4096 (BASELINE config #5) costs one kernel launch per round.
+"""
+
+import hashlib
+import logging
+
+import jax
+import numpy as np
+
+from orion_tpu.algo.base import BaseAlgorithm, algo_registry
+
+log = logging.getLogger(__name__)
+
+
+def _geometric_budgets(low, high, factor, num_rungs=None):
+    budgets = []
+    b = low
+    while b < high:
+        budgets.append(int(b))
+        b *= factor
+    budgets.append(int(high))
+    if num_rungs is not None and len(budgets) > num_rungs:
+        # Keep the extremes, thin the middle evenly.
+        idx = np.linspace(0, len(budgets) - 1, num_rungs).round().astype(int)
+        budgets = [budgets[i] for i in sorted(set(idx.tolist()))]
+    return budgets
+
+
+class Bracket:
+    """One successive-halving ladder (reference `asha.py:259-365`)."""
+
+    def __init__(self, budgets, reduction_factor):
+        self.rungs = [{"resources": b, "results": {}} for b in budgets]
+        self.reduction_factor = reduction_factor
+
+    def register(self, point_hash, params, objective, fidelity):
+        for rung in self.rungs:
+            if rung["resources"] == fidelity:
+                rung["results"][point_hash] = (objective, params)
+                return True
+        return False
+
+    def get_candidate(self, rung_index):
+        """Top-1/rf point of rung not yet present in the next rung."""
+        rung = self.rungs[rung_index]["results"]
+        next_rung = self.rungs[rung_index + 1]["results"]
+        scored = [(h, o, p) for h, (o, p) in rung.items() if o is not None]
+        scored.sort(key=lambda t: t[1])
+        k = len(rung) // self.reduction_factor
+        for h, _objective, params in scored[:k]:
+            if h not in next_rung:
+                return h, params
+        return None, None
+
+    def promote(self):
+        """Find a promotable point; returns (hash, params, next_fidelity)."""
+        for i in range(len(self.rungs) - 1):
+            point_hash, params = self.get_candidate(i)
+            if point_hash is not None:
+                # Reserve the slot so concurrent suggests don't double-promote.
+                self.rungs[i + 1]["results"][point_hash] = (None, params)
+                return point_hash, params, self.rungs[i + 1]["resources"]
+        return None, None, None
+
+    def holds(self, point_hash):
+        return any(point_hash in rung["results"] for rung in self.rungs)
+
+    @property
+    def is_filled(self):
+        return len(self.rungs[0]["results"]) >= self.reduction_factor ** (
+            len(self.rungs) - 1
+        )
+
+    @property
+    def is_done(self):
+        return bool(self.rungs[-1]["results"])
+
+    def state(self):
+        return [
+            {"resources": r["resources"], "results": dict(r["results"])}
+            for r in self.rungs
+        ]
+
+
+@algo_registry.register("asha")
+class ASHA(BaseAlgorithm):
+    requires_fidelity = True
+
+    def __init__(
+        self,
+        space,
+        seed=None,
+        num_rungs=None,
+        num_brackets=1,
+        reduction_factor=None,
+    ):
+        super().__init__(
+            space,
+            seed=seed,
+            num_rungs=num_rungs,
+            num_brackets=num_brackets,
+            reduction_factor=reduction_factor,
+        )
+        fid = space.fidelity
+        if fid is None:
+            raise RuntimeError(
+                "ASHA requires a fidelity dimension (e.g. epochs~fidelity(1, 81, 3))"
+            )
+        self.fidelity_name = fid.name
+        rf = int(reduction_factor or max(fid.base, 2))
+        if rf < 2:
+            raise ValueError(f"reduction_factor must be >= 2, got {rf}")
+        self.reduction_factor = rf
+        budgets = _geometric_budgets(fid.low, fid.high, rf, num_rungs)
+        # Bracket s skips the s lowest rungs (ASHA paper); reference asha.py:125-134.
+        num_brackets = min(num_brackets, len(budgets))
+        self.brackets = [
+            Bracket(budgets[s:], rf) for s in range(num_brackets)
+        ]
+        # point_hash -> bracket index.  A fidelity alone cannot identify the
+        # bracket with num_brackets > 1 (bracket s's rungs are budgets[s:], a
+        # subset of bracket 0's), so assignment is tracked at suggest time.
+        self._bracket_of = {}
+
+    # --- identity ------------------------------------------------------------
+    def _point_hash(self, params):
+        """md5 over non-fidelity params (reference `asha.py:204-210`)."""
+        items = sorted(
+            (k, repr(v)) for k, v in params.items() if k != self.fidelity_name
+        )
+        return hashlib.md5(repr(items).encode()).hexdigest()
+
+    # --- suggest/observe -------------------------------------------------------
+    def suggest(self, num=1):
+        out = []
+        for _ in range(num):
+            params = self._suggest_one()
+            if params is None:
+                break
+            out.append(params)
+        return out or None
+
+    def _resolve_bracket(self, point_hash, fidelity):
+        """Bracket for a point: tracked assignment, else the bracket already
+        holding it, else the first bracket with a rung at this fidelity."""
+        if point_hash in self._bracket_of:
+            return self.brackets[self._bracket_of[point_hash]]
+        for i, bracket in enumerate(self.brackets):
+            if bracket.holds(point_hash):
+                self._bracket_of[point_hash] = i
+                return bracket
+        for i, bracket in enumerate(self.brackets):
+            if any(r["resources"] == fidelity for r in bracket.rungs):
+                self._bracket_of[point_hash] = i
+                return bracket
+        return None
+
+    def _suggest_one(self):
+        # 1) promotions first
+        for bracket_idx, bracket in enumerate(self.brackets):
+            point_hash, params, fidelity = bracket.promote()
+            if params is not None:
+                self._bracket_of[point_hash] = bracket_idx
+                promoted = dict(params)
+                promoted[self.fidelity_name] = fidelity
+                return promoted
+        # 2) else new point in a softmax-chosen bracket's bottom rung
+        sizes = np.asarray(
+            [len(b.rungs[0]["results"]) for b in self.brackets], dtype=np.float64
+        )
+        logits = -sizes  # fewer points -> more likely
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        bracket_key, sample_key = jax.random.split(self.next_key())
+        bracket_idx = int(
+            np.searchsorted(np.cumsum(probs), float(jax.random.uniform(bracket_key)))
+        )
+        bracket_idx = min(bracket_idx, len(self.brackets) - 1)
+        bracket = self.brackets[bracket_idx]
+        fidelity = bracket.rungs[0]["resources"]
+        u = jax.random.uniform(sample_key, (1, self.space.n_cols))
+        params = self.space.arrays_to_params(
+            self.space.decode_flat(u), fidelity_value=fidelity
+        )[0]
+        point_hash = self._point_hash(params)
+        self._bracket_of[point_hash] = bracket_idx
+        # Pre-register the slot (objective pending) to avoid re-suggesting.
+        bracket.register(point_hash, params, None, fidelity)
+        return params
+
+    def register_suggestion(self, params):
+        """Mark a durably-registered point as pending in its rung so a future
+        producer round (with a fresh naive copy) cannot re-promote it."""
+        fidelity = int(params.get(self.fidelity_name, 0))
+        point_hash = self._point_hash(params)
+        bracket = self._resolve_bracket(point_hash, fidelity)
+        if bracket is None:
+            return
+        for rung in bracket.rungs:
+            if rung["resources"] == fidelity and point_hash not in rung["results"]:
+                rung["results"][point_hash] = (None, dict(params))
+                return
+
+    def observe(self, params_list, results):
+        for params, result in zip(params_list, results):
+            objective = result["objective"]
+            fidelity = int(params.get(self.fidelity_name, 0))
+            point_hash = self._point_hash(params)
+            bracket = self._resolve_bracket(point_hash, fidelity)
+            if bracket is None or not bracket.register(
+                point_hash, dict(params), objective, fidelity
+            ):
+                log.debug(
+                    "Observed point with unknown fidelity %s; no rung matched",
+                    fidelity,
+                )
+            self._n_observed += 1
+
+    @property
+    def is_done(self):
+        return all(b.is_done for b in self.brackets)
+
+    # --- state -------------------------------------------------------------
+    def state_dict(self):
+        out = super().state_dict()
+        out["brackets"] = [b.state() for b in self.brackets]
+        out["bracket_of"] = dict(self._bracket_of)
+        return out
+
+    def set_state(self, state):
+        super().set_state(state)
+        for bracket, saved in zip(self.brackets, state["brackets"]):
+            bracket.rungs = [
+                {"resources": r["resources"], "results": dict(r["results"])}
+                for r in saved
+            ]
+        self._bracket_of = dict(state.get("bracket_of", {}))

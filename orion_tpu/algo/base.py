@@ -108,6 +108,13 @@ class BaseAlgorithm:
     def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
         """Device-facing observation hook; default is stateless."""
 
+    def register_suggestion(self, params):
+        """Called by the producer after a suggested point is durably
+        registered as a trial.  Algorithms with in-flight bookkeeping (ASHA's
+        pending rung slots) override this so state survives across producer
+        rounds — the *naive* copy that produced the suggestion is discarded
+        every round."""
+
     @property
     def n_observed(self):
         return self._n_observed

@@ -1,0 +1,74 @@
+"""Grid search: deterministic sweep over a cartesian lattice of the space.
+
+No counterpart in the reference v0.1.7 (later Oríon versions add it); the
+grid lives in the unit cube so every dimension type (real/int/categorical)
+gets an even sweep through the codec's inverse-CDF decode.
+"""
+
+import itertools
+
+import numpy as np
+
+from orion_tpu.algo.base import BaseAlgorithm, algo_registry
+from orion_tpu.space.dims import Categorical, Integer
+
+
+@algo_registry.register("grid_search")
+class GridSearch(BaseAlgorithm):
+    """``n_values`` points per dimension (categoricals: one per category)."""
+
+    MAX_GRID = 1_000_000
+
+    def __init__(self, space, n_values=10, seed=None):
+        super().__init__(space, seed=seed, n_values=n_values)
+        axes = []
+        for dim in space:
+            if dim.n_cols == 0:
+                continue
+            for _ in range(dim.n_cols):
+                if isinstance(dim, Categorical):
+                    k = dim.n_choices
+                    axes.append((np.arange(k) + 0.5) / k)
+                elif isinstance(dim, Integer):
+                    k = min(n_values, int(dim.high - dim.low + 1))
+                    axes.append((np.arange(k) + 0.5) / k)
+                else:
+                    axes.append((np.arange(n_values) + 0.5) / n_values)
+        size = int(np.prod([len(a) for a in axes])) if axes else 0
+        if size > self.MAX_GRID:
+            raise ValueError(
+                f"grid of {size} points exceeds MAX_GRID={self.MAX_GRID}; "
+                "reduce n_values or the number of dimensions"
+            )
+        self._grid = np.asarray(list(itertools.product(*axes)), dtype=np.float32)
+        self._cursor = 0
+
+    def _suggest_cube(self, num):
+        if self._cursor >= len(self._grid):
+            return None
+        batch = self._grid[self._cursor : self._cursor + num]
+        self._cursor += len(batch)
+        return batch
+
+    def register_suggestion(self, params):
+        """Advance the REAL algorithm's cursor past durably-registered grid
+        points — suggestions come from the per-round naive deepcopy, whose
+        cursor advance would otherwise be discarded and the producer would
+        re-suggest grid[0:pool] forever (DuplicateKeyError -> SampleTimeout)."""
+        arrays = self.space.params_to_arrays([params])
+        cube = np.asarray(self.space.encode_flat(arrays))[0]
+        idx = int(np.argmin(np.sum((self._grid - cube) ** 2, axis=1)))
+        self._cursor = max(self._cursor, idx + 1)
+
+    @property
+    def is_done(self):
+        return self._cursor >= len(self._grid) and self.n_observed >= len(self._grid)
+
+    def state_dict(self):
+        out = super().state_dict()
+        out["cursor"] = self._cursor
+        return out
+
+    def set_state(self, state):
+        super().set_state(state)
+        self._cursor = state["cursor"]

@@ -1,0 +1,28 @@
+"""Hyperband: successive halving over ALL bracket offsets.
+
+The reference ships ASHA only (`src/orion/algo/asha.py`); Hyperband is its
+multi-bracket generalization (every rung offset gets a bracket, hedging the
+unknown fidelity/quality correlation) and appears in later Oríon releases —
+included here for a complete multi-fidelity family.  Same host-side rung
+logic + device sampling split as ASHA.
+"""
+
+from orion_tpu.algo.asha import ASHA, _geometric_budgets
+from orion_tpu.algo.base import algo_registry
+
+
+@algo_registry.register("hyperband")
+class Hyperband(ASHA):
+    def __init__(self, space, seed=None, num_rungs=None, reduction_factor=None):
+        fid = space.fidelity
+        if fid is None:
+            raise RuntimeError("Hyperband requires a fidelity dimension")
+        rf = int(reduction_factor or max(fid.base, 2))
+        n_brackets = len(_geometric_budgets(fid.low, fid.high, rf, num_rungs))
+        super().__init__(
+            space,
+            seed=seed,
+            num_rungs=num_rungs,
+            num_brackets=n_brackets,
+            reduction_factor=reduction_factor,
+        )

@@ -1,0 +1,34 @@
+"""Shared device-side sampling helpers for algorithms."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def reflect_unit(x):
+    """Fold values back into [0, 1] by reflection at the boundaries.
+
+    Hard clipping creates an atom at exactly 0.0/1.0 — a gaussian-perturbed
+    candidate lands on the same float over and over, which the storage's
+    unique trial index rejects until the producer times out.
+    """
+    r = jnp.mod(jnp.abs(x), 2.0)
+    return jnp.where(r > 1.0, 2.0 - r, r)
+
+
+def clamp_objectives(objectives, history):
+    """Replace non-finite objectives with the worst finite value known.
+
+    Lies may carry inf sentinels before any real completion; model-based
+    algorithms need finite targets.  Returns None when nothing finite is
+    known at all (caller should skip the batch).
+    """
+    objectives = np.asarray(objectives)
+    finite = np.isfinite(objectives)
+    if np.all(finite):
+        return objectives
+    if not np.any(finite) and history.size == 0:
+        return None
+    worst = (
+        float(np.max(objectives[finite])) if np.any(finite) else float(np.max(history))
+    )
+    return np.where(finite, objectives, worst)

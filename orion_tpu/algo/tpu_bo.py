@@ -22,6 +22,7 @@ import numpy as np
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
 from orion_tpu.algo.gp.acquisition import acquire, joint_thompson
 from orion_tpu.algo.gp.gp import fit_gp
+from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
 from orion_tpu.parallel import device_mesh, shard_candidates
 
 
@@ -108,18 +109,9 @@ class TPUBO(BaseAlgorithm):
 
     # --- observation --------------------------------------------------------
     def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
-        finite = np.isfinite(objectives)
-        if not np.all(finite):
-            # Lies may carry inf sentinels before any completion; clamp to the
-            # worst finite value seen (or drop when nothing is known yet).
-            if not np.any(finite) and self._y.size == 0:
-                return
-            worst = (
-                float(np.max(objectives[finite]))
-                if np.any(finite)
-                else float(np.max(self._y))
-            )
-            objectives = np.where(finite, objectives, worst)
+        objectives = clamp_objectives(objectives, self._y)
+        if objectives is None:
+            return
         self._x = np.concatenate([self._x, np.asarray(cube, dtype=np.float32)])
         self._y = np.concatenate([self._y, np.asarray(objectives, dtype=np.float32)])
         self._gp_dirty = True
@@ -210,13 +202,17 @@ class TPUBO(BaseAlgorithm):
 
 @partial(jax.jit, static_argnums=(1, 2, 4))
 def _make_candidates(key, n_candidates, n_dims, best_x, local_frac, local_sigma):
-    """Candidate set: global uniform + gaussian ball around the incumbent."""
+    """Candidate set: global uniform + gaussian ball around the incumbent.
+
+    Boundary handling is reflection, not clipping — clipping would pile local
+    candidates onto the exact floats 0.0/1.0 whenever the incumbent sits near
+    an edge, producing duplicate suggestions (see sampling.reflect_unit)."""
     k1, k2 = jax.random.split(key)
     n_local = int(n_candidates * local_frac)
     n_global = n_candidates - n_local
     global_c = jax.random.uniform(k1, (n_global, n_dims))
     local_c = best_x[None, :] + local_sigma * jax.random.normal(k2, (n_local, n_dims))
-    return jnp.clip(jnp.concatenate([global_c, local_c], axis=0), 0.0, 1.0)
+    return jnp.concatenate([global_c, reflect_unit(local_c)], axis=0)
 
 
 @partial(jax.jit, static_argnums=(3, 4, 5))

@@ -105,8 +105,13 @@ class Producer:
                 trial = Trial(params=params)
                 try:
                     self.experiment.register_trial(trial, parents=self._leaf_ids)
+                    self.algorithm.register_suggestion(params)
                     registered += 1
                 except DuplicateKeyError:
+                    # The point IS durably registered (by us earlier or by a
+                    # concurrent worker) — the algorithm must still learn it
+                    # is consumed, or it will re-suggest it forever.
+                    self.algorithm.register_suggestion(params)
                     log.debug("duplicate suggestion %s; backing off", trial.id)
                     self.backoff()
         return registered

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Multi-fidelity black box: more epochs -> closer to the true quadratic."""
+
+import argparse
+
+from orion_tpu.client import report_objective
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-x", type=float, required=True)
+    parser.add_argument("--epochs", type=int, required=True)
+    args = parser.parse_args()
+    true_val = (args.x - 0.6) ** 2
+    noise = 0.5 / args.epochs  # fidelity reduces bias
+    report_objective(true_val + noise)
+
+
+if __name__ == "__main__":
+    main()

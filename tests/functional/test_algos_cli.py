@@ -1,0 +1,49 @@
+"""Algorithm end-to-end runs through the real CLI (parity model: reference
+tests/functional/algos/test_algos.py)."""
+
+import os
+
+from orion_tpu.cli import main as cli_main
+from orion_tpu.storage import create_storage
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIDELITY_BOX = os.path.join(HERE, "fidelity_box.py")
+BLACK_BOX = os.path.join(HERE, "black_box.py")
+
+
+def test_asha_end_to_end(tmp_path):
+    config = tmp_path / "conf.yaml"
+    config.write_text("algorithms: asha\nstrategy: NoParallelStrategy\n")
+    rc = cli_main(
+        ["hunt", "-n", "asha-exp", "-c", str(config),
+         "--storage-path", str(tmp_path / "db.pkl"),
+         "--max-trials", "12", "--worker-trials", "12",
+         FIDELITY_BOX, "-x~uniform(0, 1)", "--epochs~fidelity(1, 9, 3)"]
+    )
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exp = storage.fetch_experiments({"name": "asha-exp"})[0]
+    completed = [
+        t for t in storage.fetch_trials(uid=exp["_id"]) if t.status == "completed"
+    ]
+    assert 6 <= len(completed) <= 12  # ASHA may declare is_done before max_trials
+    fidelities = sorted({t.params["/epochs"] for t in completed})
+    assert set(fidelities).issubset({1, 3, 9})
+    assert len(fidelities) >= 2  # promotions actually happened
+    # Promoted points re-evaluate the same x at higher fidelity.
+    by_x = {}
+    for t in completed:
+        by_x.setdefault(t.params["/x"], []).append(t.params["/epochs"])
+    assert any(len(v) > 1 for v in by_x.values())
+
+
+def test_tpe_end_to_end(tmp_path):
+    config = tmp_path / "conf.yaml"
+    config.write_text("algorithms:\n  tpe:\n    n_init: 6\n    n_candidates: 256\n")
+    rc = cli_main(
+        ["hunt", "-n", "tpe-exp", "-c", str(config),
+         "--storage-path", str(tmp_path / "db.pkl"),
+         "--max-trials", "10", "--worker-trials", "10",
+         BLACK_BOX, "-x~uniform(-50, 50)"]
+    )
+    assert rc == 0
