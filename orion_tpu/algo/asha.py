@@ -85,7 +85,11 @@ class Bracket:
 
     @property
     def is_done(self):
-        return bool(self.rungs[-1]["results"])
+        # Pending slots (objective None, promotion reserved or in flight) do
+        # NOT finish a bracket — the top-fidelity trial must be evaluated.
+        return any(
+            entry[0] is not None for entry in self.rungs[-1]["results"].values()
+        )
 
     def state(self):
         return [
@@ -154,11 +158,18 @@ class ASHA(BaseAlgorithm):
 
     def _resolve_bracket(self, point_hash, fidelity):
         """Bracket for a point: tracked assignment, else the bracket already
-        holding it, else the first bracket with a rung at this fidelity."""
+        holding it, else — for an unknown point (e.g. suggested by a
+        concurrent worker) — the bracket whose BOTTOM rung is this fidelity
+        (fresh points always enter at a bracket's bottom), else the first
+        bracket with any rung at this fidelity."""
         if point_hash in self._bracket_of:
             return self.brackets[self._bracket_of[point_hash]]
         for i, bracket in enumerate(self.brackets):
             if bracket.holds(point_hash):
+                self._bracket_of[point_hash] = i
+                return bracket
+        for i, bracket in enumerate(self.brackets):
+            if bracket.rungs[0]["resources"] == fidelity:
                 self._bracket_of[point_hash] = i
                 return bracket
         for i, bracket in enumerate(self.brackets):

@@ -18,8 +18,12 @@ DEFAULTS = {
     "pool_size": 1,
     "worker_trials": None,
     "working_dir": None,
-    "algorithms": "random",
-    "strategy": "MaxParallelStrategy",
+    # algorithms/strategy defaults are applied at experiment CREATION inside
+    # build_experiment, not here: a default injected at resolve time would be
+    # indistinguishable from a user choice, and resuming a tpe experiment
+    # without a config file would wrongly branch it back to random.
+    "algorithms": None,
+    "strategy": None,
     "heartbeat": 120.0,
     "max_idle_time": 60.0,
     "user_script_config": "config",

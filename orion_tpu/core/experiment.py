@@ -125,7 +125,9 @@ class Experiment:
         self._storage.update_heartbeat(trial)
 
     def fetch_trials(self, with_evc_tree=False):
-        if with_evc_tree and self.refers.get("root_id"):
+        if with_evc_tree:
+            # Roots have empty refers but may still have children — the tree
+            # walk itself discovers both directions.
             from orion_tpu.evc.experiment import fetch_tree_trials
 
             return fetch_tree_trials(self)
@@ -212,6 +214,8 @@ def build_experiment(
                 "metadata": {"timestamp": time.time(), **config.pop("metadata", {})},
                 **config,
             }
+            full.setdefault("algorithms", "random")
+            full.setdefault("strategy", "MaxParallelStrategy")
             full["_id"] = full.get("_id") or Trial.compute_id(name, {"v": full["version"]})
             try:
                 created = storage.create_experiment(full)
@@ -222,13 +226,23 @@ def build_experiment(
                         f"lost creation race for experiment {name!r} twice"
                     )
                 continue  # someone else created it — reload
-        # Resume path.
+        # Resume path.  Branch when the search space changed, or when an
+        # explicitly-given algorithm config differs from the stored one
+        # (an omitted algorithms key means "resume as stored", never a
+        # silent downgrade to the default).
         exp = Experiment(storage, existing)
-        if priors and dict(priors) != exp.priors:
+        priors_changed = bool(priors) and dict(priors) != exp.priors
+        new_algo = config.get("algorithms")
+        algo_changed = new_algo is not None and new_algo != exp.algo_config
+        if priors_changed or algo_changed:
             from orion_tpu.evc.builder import branch_experiment
 
             return branch_experiment(
-                storage, exp, dict(priors), branch_config=branch_config, **config
+                storage,
+                exp,
+                dict(priors) if priors else dict(exp.priors),
+                branch_config=branch_config,
+                **config,
             )
         for key in ("max_trials", "pool_size", "working_dir", "max_broken"):
             if key in config and config[key] is not None:

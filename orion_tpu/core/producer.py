@@ -34,8 +34,12 @@ class Producer:
 
     # --- observation --------------------------------------------------------
     def update(self):
-        """Sync algorithm state with storage (reference `producer.py:103-132`)."""
-        trials = self.experiment.fetch_trials()
+        """Sync algorithm state with storage (reference `producer.py:103-132`).
+
+        Trials come through the EVC tree: a branched child warm-starts from
+        its ancestors' completed trials, adapted hop by hop (reference
+        `evc/experiment.py:154-226` — the point of branching)."""
+        trials = self.experiment.fetch_trials(with_evc_tree=True)
         completed = [t for t in trials if t.status == "completed" and t.objective]
         incomplete = [t for t in trials if not t.is_stopped]
         self._update_algorithm(completed)

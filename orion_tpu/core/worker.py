@@ -74,6 +74,12 @@ def workon(
         if not success and on_error is not None:
             on_error(trial)
         iterations += 1
+    if experiment.is_broken:
+        # The budget may be exhausted on the very last worker iteration —
+        # still a broken experiment, not a clean exit.
+        raise BrokenExperiment(
+            f"experiment {experiment.name} has too many broken trials"
+        )
     return iterations
 
 

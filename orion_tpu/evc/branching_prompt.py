@@ -1,0 +1,120 @@
+"""Interactive conflict-resolution shell.
+
+Capability parity: reference
+`src/orion/core/io/interactive_commands/branching_prompt.py` — a `cmd.Cmd`
+session offering name/add/remove/rename/code/commandline/config/algo/status/
+diff/reset/auto commands with tab completion over conflicting dimension
+names; `commit` exits once everything is resolved.
+"""
+
+import cmd
+
+from orion_tpu.evc import conflicts as C
+
+
+class BranchingPrompt(cmd.Cmd):
+    intro = (
+        "Experiment configuration conflicts detected.\n"
+        "Type 'status' to list them, 'help' for commands, 'auto' to resolve "
+        "automatically, 'commit' when done."
+    )
+    prompt = "(branch) "
+
+    def __init__(self, builder):
+        super().__init__()
+        self.builder = builder
+
+    # --- inspection -----------------------------------------------------------
+    def do_status(self, _line):
+        """List conflicts and their resolution state."""
+        for conflict in self.builder.conflicts.conflicts:
+            mark = "resolved" if conflict.is_resolved else "PENDING "
+            print(f"  [{mark}] {conflict.diff()}")
+
+    def do_diff(self, _line):
+        """Print the configuration diff."""
+        for line in self.builder.conflicts.diffs():
+            print(" ", line)
+
+    # --- resolutions ----------------------------------------------------------
+    def do_name(self, line):
+        """name <new_experiment_name> — branch under a different name."""
+        self.builder.change_experiment_name(line.strip())
+
+    def do_add(self, line):
+        """add <dim> [default] — resolve a new dimension with a default."""
+        parts = line.split()
+        default = _literal(parts[1]) if len(parts) > 1 else None
+        self.builder.add_dimension(parts[0], default)
+
+    def do_remove(self, line):
+        """remove <dim> [default] — drop a missing dimension."""
+        parts = line.split()
+        default = _literal(parts[1]) if len(parts) > 1 else None
+        self.builder.remove_dimension(parts[0], default)
+
+    def do_rename(self, line):
+        """rename <old> <new> — resolve a missing dimension as renamed."""
+        old, new = line.split()
+        self.builder.rename_dimension(old, new)
+
+    def do_code(self, line):
+        """code <noeffect|unsure|break> — classify the code change."""
+        self.builder.set_code_change_type(line.strip())
+
+    def do_commandline(self, line):
+        """commandline <noeffect|unsure|break> — classify the cmdline change."""
+        self.builder.set_cli_change_type(line.strip())
+
+    def do_config(self, line):
+        """config <noeffect|unsure|break> — classify the script-config change."""
+        self.builder.set_script_config_change_type(line.strip())
+
+    def do_algo(self, _line):
+        """algo — accept the algorithm change."""
+        for conflict in self.builder.conflicts.get([C.AlgorithmConflict]):
+            conflict.try_resolve()
+
+    def do_auto(self, _line):
+        """auto — resolve everything automatically."""
+        self.builder.conflicts.try_resolve_all()
+        self.do_status("")
+
+    def do_reset(self, _line):
+        """reset — clear all resolutions."""
+        self.builder.reset()
+
+    # --- exit -----------------------------------------------------------------
+    def do_commit(self, _line):
+        """commit — finish (requires every conflict resolved)."""
+        if self.builder.conflicts.are_resolved:
+            return True
+        print("Unresolved conflicts remain:")
+        self.do_status("")
+        return False
+
+    def do_abort(self, _line):
+        """abort — leave conflicts unresolved (branching will fail)."""
+        return True
+
+    do_EOF = do_commit
+
+    # --- completion -----------------------------------------------------------
+    def _dim_names(self):
+        names = []
+        for conflict in self.builder.conflicts.conflicts:
+            if hasattr(conflict, "name"):
+                names.append(conflict.name)
+        return names
+
+    def completedefault(self, text, _line, _begidx, _endidx):
+        return [n for n in self._dim_names() if n.startswith(text)]
+
+
+def _literal(token):
+    import ast
+
+    try:
+        return ast.literal_eval(token)
+    except (ValueError, SyntaxError):
+        return token

@@ -1,0 +1,135 @@
+"""Experiment tree: fetch trials across the whole version-control lineage.
+
+Capability parity: reference `src/orion/core/evc/experiment.py` —
+`ExperimentNode` with lazy parent/children discovery through
+``refers.parent_id`` links in storage, and tree-wide trial fetching that
+applies ``adapter.forward`` to parent trials and ``adapter.backward`` to
+children trials on each hop (`evc/experiment.py:154-226`).
+"""
+
+import logging
+
+from orion_tpu.evc.adapters import build_adapter
+from orion_tpu.evc.tree import TreeNode
+
+log = logging.getLogger(__name__)
+
+
+class ExperimentNode(TreeNode):
+    """Tree node lazily materialized from storage experiment documents."""
+
+    def __init__(self, storage, config, parent=None, children=()):
+        super().__init__(config, parent=parent, children=children)
+        self.storage = storage
+        self._parent_loaded = parent is not None
+        self._children_loaded = False
+
+    @property
+    def config(self):
+        return self.item
+
+    @property
+    def exp_id(self):
+        return self.config["_id"]
+
+    @property
+    def name(self):
+        return self.config["name"]
+
+    @property
+    def version(self):
+        return self.config.get("version", 1)
+
+    @property
+    def adapter(self):
+        spec = (self.config.get("refers") or {}).get("adapter")
+        return build_adapter(spec) if spec else None
+
+    @property
+    def parent(self):
+        if not self._parent_loaded:
+            self._parent_loaded = True
+            parent_id = (self.config.get("refers") or {}).get("parent_id")
+            if parent_id:
+                docs = self.storage.fetch_experiments({"_id": parent_id})
+                if docs:
+                    node = ExperimentNode(self.storage, docs[0])
+                    self.set_parent(node)
+        return self._parent
+
+    @property
+    def children(self):
+        if not self._children_loaded:
+            self._children_loaded = True
+            docs = self.storage.fetch_experiments(
+                {"refers.parent_id": self.exp_id}
+            )
+            for doc in docs:
+                if doc["_id"] not in [c.exp_id for c in self._children]:
+                    self.add_children(
+                        ExperimentNode(self.storage, doc, parent=self)
+                    )
+        return list(self._children)
+
+    @property
+    def root(self):
+        node = self
+        while node.parent is not None:
+            node = node.parent
+        return node
+
+    def tree_name(self):
+        return f"{self.name}-v{self.version}"
+
+
+def build_node(storage, experiment):
+    docs = storage.fetch_experiments({"_id": experiment.id})
+    if not docs:
+        raise ValueError(f"experiment {experiment.id} not in storage")
+    return ExperimentNode(storage, docs[0])
+
+
+def fetch_tree_trials(experiment):
+    """All trials usable by ``experiment``: its own, plus ancestors' trials
+    adapted forward hop by hop, plus descendants' adapted backward."""
+    storage = experiment.storage
+    node = build_node(storage, experiment)
+
+    trials = list(storage.fetch_trials(uid=node.exp_id))
+
+    # Ancestors: walk up; each hop applies THIS child's adapter forward.
+    child = node
+    chain = []  # adapters from root-most hop to immediate hop
+    while child.parent is not None:
+        chain.append(child.adapter)
+        parent = child.parent
+        parent_trials = storage.fetch_trials(uid=parent.exp_id)
+        # Adapt through every hop between that ancestor and `experiment`.
+        for adapter in reversed(chain):
+            if adapter is not None:
+                parent_trials = adapter.forward(parent_trials)
+        trials.extend(parent_trials)
+        child = parent
+
+    # Descendants: recursive walk down; each hop applies the CHILD's adapter
+    # backward.
+    def collect_descendants(n, adapters):
+        for ch in n.children:
+            ch_trials = storage.fetch_trials(uid=ch.exp_id)
+            hop = adapters + [ch.adapter]
+            adapted = ch_trials
+            for adapter in reversed(hop):
+                if adapter is not None:
+                    adapted = adapter.backward(adapted)
+            trials.extend(adapted)
+            collect_descendants(ch, hop)
+
+    collect_descendants(node, [])
+
+    # Dedup by id, own-experiment trials first.
+    seen, out = set(), []
+    for trial in trials:
+        if trial.id not in seen:
+            seen.add(trial.id)
+            out.append(trial)
+    return out

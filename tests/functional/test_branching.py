@@ -1,0 +1,39 @@
+"""EVC branching through the real CLI (parity model: reference
+tests/functional/branching/test_branching.py)."""
+
+import os
+
+from orion_tpu.cli import main as cli_main
+from orion_tpu.storage import create_storage
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BLACK_BOX = os.path.join(HERE, "black_box.py")
+
+
+def test_hunt_with_changed_prior_branches(tmp_path):
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    cli_main(["hunt", "-n", "br", *db, "--max-trials", "3", "--worker-trials", "3",
+              BLACK_BOX, "-x~uniform(-50, 50)"])
+    rc = cli_main(["hunt", "-n", "br", *db, "--max-trials", "3", "--worker-trials", "3",
+                   BLACK_BOX, "-x~uniform(-10, 10)"])
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exps = {e["version"]: e for e in storage.fetch_experiments({"name": "br"})}
+    assert set(exps) == {1, 2}
+    child = exps[2]
+    assert child["refers"]["parent_id"] == exps[1]["_id"]
+    assert child["priors"] == {"/x": "uniform(-10, 10)"}
+    v2_trials = [t for t in storage.fetch_trials(uid=child["_id"])]
+    assert len(v2_trials) == 3
+    for t in v2_trials:
+        assert -10 <= t.params["/x"] <= 10
+
+
+def test_resume_same_config_does_not_branch(tmp_path):
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    cli_main(["hunt", "-n", "same", *db, "--max-trials", "4", "--worker-trials", "2",
+              BLACK_BOX, "-x~uniform(-50, 50)"])
+    cli_main(["hunt", "-n", "same", *db, "--max-trials", "4", "--worker-trials", "2",
+              BLACK_BOX, "-x~uniform(-50, 50)"])
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    assert len(storage.fetch_experiments({"name": "same"})) == 1

@@ -132,3 +132,14 @@ def test_hunt_without_script_on_new_experiment_fails_cleanly(tmp_path):
     # Nothing must have been persisted: the correct follow-up run starts clean.
     storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
     assert storage.fetch_experiments({"name": "ghost"}) == []
+
+
+def test_broken_budget_on_final_iteration_reports_error(tmp_path):
+    """worker_trials == max_broken: the loop ends exactly as the budget is
+    exhausted — must still exit with an error, not a clean stats print."""
+    rc = cli_main(
+        ["hunt", "-n", "edge", *storage_args(tmp_path),
+         "--max-trials", "10", "--max-broken", "2", "--worker-trials", "2",
+         BROKEN_BOX, "-x~uniform(-50,50)"]
+    )
+    assert rc == 1

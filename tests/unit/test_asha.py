@@ -97,3 +97,27 @@ def test_multiple_brackets():
     # New points land in SOME bracket's bottom rung.
     fids = {asha.suggest(1)[0]["epochs"] for _ in range(10)}
     assert fids.issubset({1, 3, 9})
+
+
+def test_not_done_while_top_rung_pending(asha):
+    for _ in range(30):
+        p = asha.suggest(1)[0]
+        if p["epochs"] == 9:
+            break
+        asha.observe([p], [{"objective": p["x"]}])
+    assert p["epochs"] == 9
+    assert not asha.is_done  # promoted but unevaluated top-fidelity point
+    asha.observe([p], [{"objective": 0.0}])
+    assert asha.is_done
+
+
+def test_unknown_point_routes_to_bottom_rung_bracket():
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 9, 3)"})
+    hb = create_algo(space, "hyperband", seed=0)
+    # A concurrent worker's fresh point at fidelity 3 (bracket 1's bottom):
+    hb.register_suggestion({"x": 0.42, "epochs": 3})
+    assert len(hb.brackets[1].rungs[0]["results"]) == 1  # NOT bracket 0 rung 1
+    assert len(hb.brackets[0].rungs[1]["results"]) == 0
