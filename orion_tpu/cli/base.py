@@ -73,8 +73,12 @@ def load_cli_config(args):
     return resolve_config(file_config, cmd_config, storage_override)
 
 
-def build_from_args(args, need_user_args=True):
-    """CLI args -> (experiment, cmdline_parser), with storage wired up."""
+def build_from_args(args, need_user_args=True, allow_create=True):
+    """CLI args -> (experiment, cmdline_parser), with storage wired up.
+
+    ``allow_create=False`` (read-only commands: info, status, insert) only
+    loads existing experiments — a typo'd name must never persist a ghost.
+    """
     config = load_cli_config(args)
     if not config.get("name"):
         raise NoConfigurationError("an experiment name is required (-n/--name)")
@@ -83,12 +87,14 @@ def build_from_args(args, need_user_args=True):
     parser = CommandLineParser(config_prefix=config.get("user_script_config", "config"))
     user_args = list(getattr(args, "user_args", []) or [])
     priors = parser.parse(user_args)
-    if need_user_args and not user_args:
-        # Only an existing experiment (with a stored command template) can be
-        # resumed without a script; check BEFORE build_experiment would
-        # persist an empty, priors-less experiment.
+    if not allow_create or (need_user_args and not user_args):
+        # Check BEFORE build_experiment would persist an empty experiment.
         existing = storage.fetch_experiments({"name": config["name"]})
         if not existing:
+            if not allow_create:
+                raise NoConfigurationError(
+                    f"no experiment named {config['name']!r} found"
+                )
             raise NoConfigurationError(
                 "a user script command is required for a new experiment"
             )

@@ -1,0 +1,142 @@
+"""`orion-tpu db {setup,test,upgrade}`: storage administration.
+
+Capability parity: reference `src/orion/core/cli/db/` + `cli/setup.py` +
+`cli/checks/` — ``setup`` writes the user-level configuration file,
+``test`` runs the three staged check suites (presence / creation /
+operations, reference `cli/checks/`), ``upgrade`` migrates stored documents
+to the current schema (indexes + config backfill, reference
+`cli/db/upgrade.py:96-183`).
+"""
+
+import os
+
+import yaml
+
+from orion_tpu.cli.base import load_cli_config
+from orion_tpu.config import user_config_path
+from orion_tpu.storage.base import setup_storage
+from orion_tpu.utils.exceptions import CheckError
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser("db", help="storage administration")
+    sub = parser.add_subparsers(dest="db_command", metavar="ACTION")
+
+    setup_p = sub.add_parser("setup", help="write the user configuration file")
+    setup_p.add_argument("--storage-type", default="pickled", choices=["pickled", "memory"])
+    setup_p.add_argument("--path", default=None, help="pickled DB file path")
+    setup_p.set_defaults(func=main_setup)
+
+    test_p = sub.add_parser("test", help="run staged storage checks")
+    _common(test_p)
+    test_p.set_defaults(func=main_test)
+
+    up_p = sub.add_parser("upgrade", help="migrate stored documents to the current schema")
+    _common(up_p)
+    up_p.set_defaults(func=main_upgrade)
+
+    parser.set_defaults(func=lambda args: parser.print_help() or 1)
+    return parser
+
+
+def _common(parser):
+    parser.add_argument("-c", "--config", metavar="path", default=None)
+    parser.add_argument("--storage-path", default=None)
+    parser.add_argument("--debug", action="store_true")
+
+
+def main_setup(args):
+    path = user_config_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    storage = {"type": args.storage_type}
+    if args.path:
+        storage["path"] = os.path.abspath(args.path)
+    elif args.storage_type == "pickled":
+        storage["path"] = os.path.join(
+            os.path.dirname(path), "orion_tpu_db.pkl"
+        )
+    existing = {}
+    if os.path.exists(path):
+        with open(path) as handle:
+            existing = yaml.safe_load(handle) or {}
+    existing["storage"] = storage
+    with open(path, "w") as handle:
+        yaml.safe_dump(existing, handle, default_flow_style=False)
+    print(f"Wrote storage configuration to {path}")
+    return 0
+
+
+# --- staged checks (reference cli/checks/: presence, creation, operations) ---
+
+
+def check_presence(config):
+    """Stage 1: a storage configuration can be resolved at all."""
+    if not config.get("storage") or not config["storage"].get("type"):
+        raise CheckError("no storage configuration found")
+    return f"storage type {config['storage']['type']!r}"
+
+
+def check_creation(config):
+    """Stage 2: the backend can be instantiated and locked."""
+    storage = setup_storage(config["storage"], force=True)
+    storage.db.read("experiments", {"_id": "__check__"})
+    return type(storage.db).__name__
+
+
+def check_operations(config):
+    """Stage 3: write / read / count / remove roundtrip."""
+    storage = setup_storage(config["storage"], force=True)
+    db = storage.db
+    db.remove("_checks", {})
+    db.write("_checks", {"_id": "c1", "value": 1})
+    if db.count("_checks") != 1:
+        raise CheckError("count after write != 1")
+    doc = db.read_and_write("_checks", {"_id": "c1"}, {"value": 2})
+    if doc is None or doc["value"] != 2:
+        raise CheckError("read_and_write failed")
+    db.remove("_checks", {})
+    if db.count("_checks") != 0:
+        raise CheckError("remove failed")
+    return "write/read/cas/remove ok"
+
+
+def main_test(args):
+    config = load_cli_config(args)
+    failures = 0
+    for stage, check in (
+        ("presence", check_presence),
+        ("creation", check_creation),
+        ("operations", check_operations),
+    ):
+        try:
+            detail = check(config)
+            print(f"check {stage}... ok ({detail})")
+        except Exception as exc:
+            print(f"check {stage}... FAIL: {exc}")
+            failures += 1
+            break  # later stages depend on earlier ones
+    return 1 if failures else 0
+
+
+def main_upgrade(args):
+    """Schema migration: re-ensure indexes, backfill missing fields."""
+    config = load_cli_config(args)
+    storage = setup_storage(config["storage"], force=True)
+    migrated = 0
+    for doc in storage.fetch_experiments({}):
+        updates = {}
+        if "version" not in doc:
+            updates["version"] = 1
+        if "priors" not in doc:
+            updates["priors"] = (doc.get("metadata") or {}).get("priors", {})
+        if "refers" not in doc:
+            updates["refers"] = {}
+        if updates:
+            storage.update_experiment(uid=doc["_id"], **updates)
+            migrated += 1
+    # Trials: backfill parents list.
+    n_trials = storage.db.write(
+        "trials", {"parents": []}, query={"parents": None}
+    )
+    print(f"Upgraded {migrated} experiments, {n_trials} trials.")
+    return 0

@@ -1,0 +1,72 @@
+"""`orion-tpu info`: pretty-print one experiment.
+
+Capability parity: reference `src/orion/core/cli/info.py` — sections for
+commandline, config, algorithm, space, metadata, refers (EVC lineage), and
+stats.
+"""
+
+import time
+
+from orion_tpu.cli.base import add_experiment_args, build_from_args
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser("info", help="show experiment details")
+    add_experiment_args(parser, with_user_args=False)
+    parser.set_defaults(func=main)
+    return parser
+
+
+def _section(title):
+    return f"\n{title}\n{'=' * len(title)}"
+
+
+def format_info(experiment):
+    out = [_section("Commandline")]
+    out.append(" ".join(experiment.metadata.get("user_args", [])) or "(none)")
+
+    out.append(_section("Config"))
+    for key in ("pool_size", "max_trials", "max_broken", "working_dir"):
+        out.append(f"{key}: {getattr(experiment, key)}")
+
+    out.append(_section("Algorithm"))
+    out.append(repr(experiment.algo_config))
+    out.append(f"strategy: {experiment.strategy_config!r}")
+
+    out.append(_section("Space"))
+    for name, prior in sorted(experiment.priors.items()):
+        out.append(f"{name}: {prior}")
+
+    out.append(_section("Meta-data"))
+    out.append(f"name: {experiment.name}")
+    out.append(f"version: {experiment.version}")
+    ts = experiment.metadata.get("timestamp")
+    if ts:
+        out.append(f"datetime: {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(ts))}")
+    if experiment.metadata.get("user_script"):
+        out.append(f"user_script: {experiment.metadata['user_script']}")
+
+    out.append(_section("Parent experiment"))
+    refers = experiment.refers or {}
+    out.append(f"root: {refers.get('root_id') or experiment.id}")
+    out.append(f"parent: {refers.get('parent_id') or '(none)'}")
+
+    out.append(_section("Stats"))
+    stats = experiment.stats()
+    out.append(f"trials completed: {stats['trials_completed']}")
+    if stats.get("best_evaluation") is not None:
+        out.append(f"best evaluation: {stats['best_evaluation']}")
+        out.append(f"best trial: {stats['best_trials_id']}")
+        for key, value in sorted(stats.get("best_params", {}).items()):
+            out.append(f"  {key}: {value}")
+    if stats.get("start_time"):
+        out.append(f"start time: {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(stats['start_time']))}")
+    if stats.get("duration") is not None:
+        out.append(f"duration: {stats['duration']:.1f}s")
+    return "\n".join(out) + "\n"
+
+
+def main(args):
+    experiment, _parser = build_from_args(args, need_user_args=False, allow_create=False)
+    print(format_info(experiment))
+    return 0

@@ -10,12 +10,38 @@ global Configuration object.
 
 import os
 
+import yaml
+
+
+def user_config_path():
+    """``~/.config/orion_tpu/config.yaml`` (XDG_CONFIG_HOME honored)."""
+    base = os.environ.get(
+        "XDG_CONFIG_HOME", os.path.join(os.path.expanduser("~"), ".config")
+    )
+    return os.path.join(base, "orion_tpu", "config.yaml")
+
+
+def _user_file_config():
+    path = user_config_path()
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as handle:
+            return yaml.safe_load(handle) or {}
+    except Exception:  # pragma: no cover - malformed user config
+        return {}
+
+
 DEFAULTS = {
     "name": None,
     "version": None,
-    "max_trials": float("inf"),
-    "max_broken": 3,
-    "pool_size": 1,
+    # Per-experiment knobs default to None here: a value present at resolve
+    # time is indistinguishable from a user choice and would override the
+    # stored experiment's own settings on resume.  Creation-time defaults
+    # live in Experiment.__init__ (max_trials=inf, max_broken=3, pool_size=1).
+    "max_trials": None,
+    "max_broken": None,
+    "pool_size": None,
     "worker_trials": None,
     "working_dir": None,
     # algorithms/strategy defaults are applied at experiment CREATION inside
@@ -63,7 +89,10 @@ def merge_configs(*configs):
 
 
 def resolve_config(file_config=None, cmd_config=None, storage_override=None):
-    config = merge_configs(DEFAULTS, _env_config(), file_config, cmd_config)
+    """defaults < user config file < env < -c config file < cmdline."""
+    config = merge_configs(
+        DEFAULTS, _user_file_config(), _env_config(), file_config, cmd_config
+    )
     if storage_override:
         config["storage"] = storage_override
     return config

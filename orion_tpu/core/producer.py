@@ -31,6 +31,15 @@ class Producer:
         self._observed_ids = set()  # replaces reference TrialsHistory dedup
         self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
         self.failure_count = 0
+        # Probe the EVC family ONCE: walking the tree costs extra collection
+        # scans per round (each a full lock/unpickle on the file backend),
+        # which an un-branched experiment should never pay.  A branch
+        # appearing mid-run is picked up by the next worker process.
+        self._has_evc_family = bool(experiment.refers.get("parent_id")) or bool(
+            experiment.storage.fetch_experiments(
+                {"refers.parent_id": experiment.id}, projection={"_id": 1}
+            )
+        )
 
     # --- observation --------------------------------------------------------
     def update(self):
@@ -39,7 +48,7 @@ class Producer:
         Trials come through the EVC tree: a branched child warm-starts from
         its ancestors' completed trials, adapted hop by hop (reference
         `evc/experiment.py:154-226` — the point of branching)."""
-        trials = self.experiment.fetch_trials(with_evc_tree=True)
+        trials = self.experiment.fetch_trials(with_evc_tree=self._has_evc_family)
         completed = [t for t in trials if t.status == "completed" and t.objective]
         incomplete = [t for t in trials if not t.is_stopped]
         self._update_algorithm(completed)

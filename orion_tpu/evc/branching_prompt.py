@@ -97,7 +97,13 @@ class BranchingPrompt(cmd.Cmd):
         """abort — leave conflicts unresolved (branching will fail)."""
         return True
 
-    do_EOF = do_commit
+    def do_EOF(self, _line):
+        """End of input: commit if everything is resolved, else abort —
+        looping back to the prompt would spin forever on closed stdin."""
+        if self.builder.conflicts.are_resolved:
+            return True
+        print("EOF with unresolved conflicts; aborting branch.")
+        return True
 
     # --- completion -----------------------------------------------------------
     def _dim_names(self):

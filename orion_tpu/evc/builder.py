@@ -84,13 +84,16 @@ class ExperimentBranchBuilder:
 
     # --- driving -------------------------------------------------------------
     def resolve(self):
+        if self.branch_to:
+            self.change_experiment_name(self.branch_to)
         if self.manual_resolution:
+            # The user's decisions (including leaving conflicts unresolved
+            # via `abort`) are final — no automatic pass afterwards.
             from orion_tpu.evc.branching_prompt import BranchingPrompt
 
             BranchingPrompt(self).cmdloop()
-        if self.branch_to:
-            self.change_experiment_name(self.branch_to)
-        self.conflicts.try_resolve_all()
+        else:
+            self.conflicts.try_resolve_all()
         return self.conflicts
 
     def create_adapters(self):
@@ -122,9 +125,11 @@ def branch_experiment(storage, parent, new_priors, branch_config=None, **config)
     builder.resolve()
     remaining = conflicts.get_remaining()
     if remaining:
-        raise RaceCondition(
+        raise ValueError(
             "unresolved branching conflicts: "
             + "; ".join(c.diff() for c in remaining)
+            + " — add branching markers (+ - >) or default_value=..., or use "
+            "--manual-resolution"
         )
 
     name_res = next(

@@ -74,7 +74,12 @@ class NewDimensionConflict(Conflict):
         if default_value is NotSet:
             default_value = self.dimension.default_value
         if default_value is NotSet:
-            default_value = None
+            # No default -> parent trials cannot be mapped into the child
+            # (None params would corrupt model warm-starts); refuse so the
+            # user supplies `default_value=` in the prior expression.
+            raise ValueError(
+                f"new dimension {self.name!r} needs a default_value to branch"
+            )
         return self._resolve(
             adapter=DimensionAddition(self.name, default_value),
             default_value=default_value,

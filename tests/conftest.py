@@ -27,6 +27,12 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _isolate_user_config(tmp_path, monkeypatch):
+    """Tests must never inherit the developer's ~/.config/orion_tpu."""
+    monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "xdg-isolated"))
+
+
 @pytest.fixture
 def rng_seed():
     """Pin numpy global RNG for legacy-style deterministic tests."""

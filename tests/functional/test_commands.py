@@ -1,0 +1,137 @@
+"""info / status / list / insert / db command behavior through the real CLI.
+
+Parity model: reference tests/functional/commands/.
+"""
+
+import os
+
+import pytest
+
+from orion_tpu.cli import main as cli_main
+from orion_tpu.storage import create_storage
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BLACK_BOX = os.path.join(HERE, "black_box.py")
+
+
+@pytest.fixture
+def populated(tmp_path):
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    cli_main(["hunt", "-n", "cmd-exp", *db, "--max-trials", "4", "--worker-trials", "4",
+              BLACK_BOX, "-x~uniform(-50, 50)"])
+    return tmp_path, db
+
+
+def test_info(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["info", "-n", "cmd-exp", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cmd-exp" in out
+    assert "/x: uniform(-50, 50)" in out
+    assert "trials completed: 4" in out
+    assert "best evaluation:" in out
+
+
+def test_status(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["status", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cmd-exp-v1" in out
+    assert "completed" in out and "4" in out
+
+
+def test_status_all_lists_trials(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["status", "-n", "cmd-exp", "--all", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("completed") == 4
+
+
+def test_list_shows_evc_tree(populated, capsys):
+    tmp_path, db = populated
+    # Branch it to get a tree.
+    cli_main(["hunt", "-n", "cmd-exp", *db, "--max-trials", "2", "--worker-trials", "0",
+              BLACK_BOX, "-x~uniform(-10, 10)"])
+    rc = cli_main(["list", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "cmd-exp-v1" in out
+    assert "└── cmd-exp-v2" in out
+
+
+def test_insert_and_defaults(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["insert", "-n", "cmd-exp", *db, "x=3.5"])
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exp = storage.fetch_experiments({"name": "cmd-exp"})[0]
+    new = [t for t in storage.fetch_trials(uid=exp["_id"]) if t.status == "new"]
+    assert len(new) == 1
+    assert new[0].params == {"/x": 3.5}
+
+
+def test_insert_rejects_out_of_bounds(populated):
+    tmp_path, db = populated
+    with pytest.raises(ValueError):
+        cli_main(["insert", "-n", "cmd-exp", *db, "x=999"])
+
+
+def test_db_test_checks(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["db", "test", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "check presence... ok" in out
+    assert "check creation... ok" in out
+    assert "check operations... ok" in out
+
+
+def test_db_upgrade_backfills(populated, capsys):
+    tmp_path, db = populated
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    # Simulate an old-schema experiment document.
+    storage.db.write("experiments", {"_id": "old1", "name": "legacy"})
+    rc = cli_main(["db", "upgrade", *db])
+    assert rc == 0
+    doc = storage.fetch_experiments({"name": "legacy"})[0]
+    assert doc["version"] == 1
+    assert doc["priors"] == {}
+    assert doc["refers"] == {}
+
+
+def test_db_setup_writes_user_config(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "cfg"))
+    rc = cli_main(["db", "setup", "--path", str(tmp_path / "mydb.pkl")])
+    assert rc == 0
+    import yaml
+
+    path = tmp_path / "cfg" / "orion_tpu" / "config.yaml"
+    data = yaml.safe_load(path.read_text())
+    assert data["storage"]["type"] == "pickled"
+    assert data["storage"]["path"] == str(tmp_path / "mydb.pkl")
+
+
+def test_resume_preserves_stored_budgets(populated, capsys):
+    """Regression: resolver defaults must not override stored per-experiment
+    settings on resume (max_trials inf clobbered a stored value)."""
+    tmp_path, db = populated
+    rc = cli_main(["info", "-n", "cmd-exp", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "max_trials: 4" in out
+
+
+def test_info_unknown_experiment_no_ghost(tmp_path):
+    """Regression: read-only commands must not persist ghost experiments."""
+    from orion_tpu.utils.exceptions import NoConfigurationError
+
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    with pytest.raises(NoConfigurationError):
+        cli_main(["info", "-n", "typo", *db])
+    with pytest.raises(NoConfigurationError):
+        cli_main(["insert", "-n", "typo", *db, "x=1"])
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    assert storage.fetch_experiments({}) == []

@@ -315,3 +315,15 @@ def test_branched_child_warm_starts_from_parent(storage):
         t.params["/x"] for t in storage.fetch_trials(uid=e1.id) if t.params["/x"] <= 5
     ]
     assert len(e2.algorithm.observed_params) == len(parent_xs)
+
+
+def test_new_dimension_without_default_refuses_branch(storage):
+    e1 = build_experiment(storage, "nd", priors={"/x": "uniform(0, 10)"}).instantiate()
+    run_trials(e1, [1.0])
+    with pytest.raises(ValueError, match="default_value"):
+        build_experiment(
+            storage, "nd",
+            priors={"/x": "uniform(0, 10)", "/y": "+uniform(0, 1)"},
+        )
+    # Nothing persisted for the failed branch.
+    assert len(storage.fetch_experiments({"name": "nd"})) == 1
