@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Mixed Real/Integer/Categorical tuning example — BASELINE config #4.
+
+Tunes a LeNet-style classifier over learning rate (real, log scale), batch
+size (integer), width multiplier (integer), and activation (categorical).
+The model is a small flax-free jax MLP-conv hybrid trained on a synthetic
+MNIST-shaped dataset when torchvision data is unavailable (this image has no
+network egress); plug in real MNIST tensors to reproduce the docs example.
+
+Run under the framework:
+
+    orion-tpu hunt -n lenet --storage-path db.pkl --max-trials 20 \\
+        examples/mnist_lenet.py \\
+        --lr~'loguniform(1e-4, 1e-1)' \\
+        --batch-size~'uniform(32, 256, discrete=True)' \\
+        --width~'uniform(1, 4, discrete=True)' \\
+        --act~"choices(['relu', 'tanh', 'gelu'])"
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.client import report_objective
+
+ACTS = {"relu": jax.nn.relu, "tanh": jnp.tanh, "gelu": jax.nn.gelu}
+
+
+def synthetic_mnist(n=2048, seed=0):
+    """Deterministic MNIST-shaped stand-in (28x28 images, 10 classes)."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 28 * 28)).astype(np.float32)
+    w_true = rng.normal(size=(28 * 28, 10)).astype(np.float32)
+    y = np.argmax(x @ w_true + 0.5 * rng.normal(size=(n, 10)), axis=1)
+    return x, y.astype(np.int32)
+
+
+def train_eval(lr, batch_size, width, act_name, epochs=3, seed=0):
+    x, y = synthetic_mnist()
+    n_train = len(x) * 3 // 4
+    act = ACTS[act_name]
+    hidden = 32 * width
+    key = jax.random.PRNGKey(seed)
+    k1, k2 = jax.random.split(key)
+    params = {
+        "w1": jax.random.normal(k1, (784, hidden)) * (1.0 / 28.0),
+        "b1": jnp.zeros(hidden),
+        "w2": jax.random.normal(k2, (hidden, 10)) * (1.0 / jnp.sqrt(hidden)),
+        "b2": jnp.zeros(10),
+    }
+
+    def forward(p, xb):
+        h = act(xb @ p["w1"] + p["b1"])
+        return h @ p["w2"] + p["b2"]
+
+    def loss_fn(p, xb, yb):
+        logits = forward(p, xb)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(logp[jnp.arange(len(yb)), yb])
+
+    @jax.jit
+    def step(p, xb, yb):
+        grads = jax.grad(loss_fn)(p, xb, yb)
+        return jax.tree.map(lambda a, g: a - lr * g, p, grads)
+
+    xb_train, yb_train = jnp.asarray(x[:n_train]), jnp.asarray(y[:n_train])
+    for _epoch in range(epochs):
+        for i in range(0, n_train, batch_size):
+            params = step(
+                params, xb_train[i : i + batch_size], yb_train[i : i + batch_size]
+            )
+    logits = forward(params, jnp.asarray(x[n_train:]))
+    acc = float(jnp.mean(jnp.argmax(logits, axis=1) == jnp.asarray(y[n_train:])))
+    return 1.0 - acc  # minimize validation error
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--lr", type=float, required=True)
+    parser.add_argument("--batch-size", type=int, required=True)
+    parser.add_argument("--width", type=int, required=True)
+    parser.add_argument("--act", required=True)
+    args = parser.parse_args()
+    error = train_eval(args.lr, args.batch_size, args.width, args.act)
+    report_objective(error)
+
+
+if __name__ == "__main__":
+    main()

@@ -148,12 +148,18 @@ class ASHA(BaseAlgorithm):
 
     # --- suggest/observe -------------------------------------------------------
     def suggest(self, num=1):
+        """Promotions first, then new points batched in ONE device draw —
+        an ASHA sweep at q=4096 (BASELINE config #5) costs a single kernel
+        launch for sampling, not 4096."""
         out = []
-        for _ in range(num):
-            params = self._suggest_one()
-            if params is None:
+        while len(out) < num:
+            promoted = self._promote_one()
+            if promoted is None:
                 break
-            out.append(params)
+            out.append(promoted)
+        remaining = num - len(out)
+        if remaining:
+            out.extend(self._sample_new(remaining))
         return out or None
 
     def _resolve_bracket(self, point_hash, fidelity):
@@ -178,8 +184,7 @@ class ASHA(BaseAlgorithm):
                 return bracket
         return None
 
-    def _suggest_one(self):
-        # 1) promotions first
+    def _promote_one(self):
         for bracket_idx, bracket in enumerate(self.brackets):
             point_hash, params, fidelity = bracket.promote()
             if params is not None:
@@ -187,7 +192,12 @@ class ASHA(BaseAlgorithm):
                 promoted = dict(params)
                 promoted[self.fidelity_name] = fidelity
                 return promoted
-        # 2) else new point in a softmax-chosen bracket's bottom rung
+        return None
+
+    def _sample_new(self, num):
+        # Softmax over negative bottom-rung occupancy chooses a bracket per
+        # point (reference `asha.py:191-198`), vectorized host-side; the
+        # actual sampling is one batched device draw.
         sizes = np.asarray(
             [len(b.rungs[0]["results"]) for b in self.brackets], dtype=np.float64
         )
@@ -195,21 +205,24 @@ class ASHA(BaseAlgorithm):
         probs = np.exp(logits - logits.max())
         probs /= probs.sum()
         bracket_key, sample_key = jax.random.split(self.next_key())
-        bracket_idx = int(
-            np.searchsorted(np.cumsum(probs), float(jax.random.uniform(bracket_key)))
+        draws = np.asarray(jax.random.uniform(bracket_key, (num,)))
+        bracket_ids = np.minimum(
+            np.searchsorted(np.cumsum(probs), draws), len(self.brackets) - 1
         )
-        bracket_idx = min(bracket_idx, len(self.brackets) - 1)
-        bracket = self.brackets[bracket_idx]
-        fidelity = bracket.rungs[0]["resources"]
-        u = jax.random.uniform(sample_key, (1, self.space.n_cols))
-        params = self.space.arrays_to_params(
-            self.space.decode_flat(u), fidelity_value=fidelity
-        )[0]
-        point_hash = self._point_hash(params)
-        self._bracket_of[point_hash] = bracket_idx
-        # Pre-register the slot (objective pending) to avoid re-suggesting.
-        bracket.register(point_hash, params, None, fidelity)
-        return params
+        u = jax.random.uniform(sample_key, (num, self.space.n_cols))
+        arrays = self.space.decode_flat(u)
+        out = []
+        for i, params in enumerate(self.space.arrays_to_params(arrays)):
+            bracket_idx = int(bracket_ids[i])
+            bracket = self.brackets[bracket_idx]
+            fidelity = bracket.rungs[0]["resources"]
+            params[self.fidelity_name] = fidelity
+            point_hash = self._point_hash(params)
+            self._bracket_of[point_hash] = bracket_idx
+            # Pre-register the slot (objective pending) to avoid re-suggesting.
+            bracket.register(point_hash, params, None, fidelity)
+            out.append(params)
+        return out
 
     def register_suggestion(self, params):
         """Mark a durably-registered point as pending in its rung so a future

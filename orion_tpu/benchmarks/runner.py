@@ -1,0 +1,91 @@
+"""Benchmark runner for the BASELINE.md configurations.
+
+Measures, per (algorithm, function) pair: best objective, simple regret,
+suggestions/sec, wall-clock.  The five BASELINE configs map to presets:
+
+1. random / Branin 2D, 200 trials
+2. (anchor — sequential CPU GP-EI, implemented in bench.py)
+3. tpu_bo q=256 Thompson / Rosenbrock-20D
+4. mixed Real/Integer/Categorical space (LeNet-style hparams, synthetic
+   objective standing in for MNIST training — see examples/)
+5. ASHA-style multi-fidelity / Ackley-50D, q=4096
+
+Run as ``python -m orion_tpu.benchmarks.runner [preset ...]``.
+"""
+
+import json
+import time
+
+from orion_tpu.benchmarks.functions import BENCHMARKS
+from orion_tpu.client.experiment import optimize
+
+
+def _uniform_priors(n_dims):
+    return {f"x{i:02d}": "uniform(0, 1)" for i in range(n_dims)}
+
+
+PRESETS = {
+    "random-branin": dict(
+        priors=_uniform_priors(2), fn="branin", algorithm="random",
+        max_trials=200, batch_size=50,
+    ),
+    "tpu_bo-hartmann6": dict(
+        priors=_uniform_priors(6), fn="hartmann6",
+        algorithm={"tpu_bo": {"n_init": 16, "n_candidates": 8192, "fit_steps": 40}},
+        max_trials=192, batch_size=16,
+    ),
+    "thompson-rosenbrock20": dict(
+        priors=_uniform_priors(20), fn="rosenbrock20",
+        algorithm={"tpu_bo": {"n_init": 256, "n_candidates": 16384, "fit_steps": 30}},
+        max_trials=1024, batch_size=256,
+    ),
+    "asha-ackley50": dict(
+        priors={**_uniform_priors(50), "budget": "fidelity(1, 16, 4)"},
+        fn="ackley50", algorithm="asha", strategy="NoParallelStrategy",
+        max_trials=4096, batch_size=4096,
+    ),
+}
+
+
+def run_preset(name, seed=0):
+    cfg = dict(PRESETS[name])
+    spec = BENCHMARKS[cfg.pop("fn")]
+    fn = spec["fn"]
+
+    def batch_eval(cube):
+        return fn(cube)
+
+    t0 = time.perf_counter()
+    stats = optimize(
+        fn=None,
+        priors=cfg["priors"],
+        max_trials=cfg["max_trials"],
+        batch_size=cfg["batch_size"],
+        algorithm=cfg["algorithm"],
+        strategy=cfg.get("strategy"),
+        seed=seed,
+        name=f"bench-{name}-{seed}",
+        batch_eval=batch_eval,
+    )
+    wall = time.perf_counter() - t0
+    best = stats["best_evaluation"]
+    return {
+        "preset": name,
+        "best": best,
+        "simple_regret": (best - spec["optimum"]) if best is not None else None,
+        "trials": stats["trials_completed"],
+        "wall_s": round(wall, 2),
+        "suggestions_per_sec": round(stats["trials_completed"] / wall, 2),
+    }
+
+
+def main(argv=None):
+    import sys
+
+    names = (argv if argv is not None else sys.argv[1:]) or list(PRESETS)
+    for name in names:
+        print(json.dumps(run_preset(name)))
+
+
+if __name__ == "__main__":
+    main()

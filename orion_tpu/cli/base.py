@@ -88,12 +88,17 @@ def build_from_args(args, need_user_args=True, allow_create=True):
     user_args = list(getattr(args, "user_args", []) or [])
     priors = parser.parse(user_args)
     if not allow_create or (need_user_args and not user_args):
-        # Check BEFORE build_experiment would persist an empty experiment.
-        existing = storage.fetch_experiments({"name": config["name"]})
+        # Check BEFORE build_experiment would persist an empty experiment —
+        # including the requested version, or a typo'd --exp-version would
+        # pass the name check and still create a ghost.
+        query = {"name": config["name"]}
+        if config.get("version") is not None:
+            query["version"] = config["version"]
+        existing = storage.fetch_experiments(query)
         if not existing:
             if not allow_create:
                 raise NoConfigurationError(
-                    f"no experiment named {config['name']!r} found"
+                    f"no experiment matching {query} found"
                 )
             raise NoConfigurationError(
                 "a user script command is required for a new experiment"

@@ -60,20 +60,32 @@ def main(args):
         print("No experiment found")
         return 0
 
+    if getattr(args, "collapse", False):
+        # Group by EVC root (refers.root_id), not by name — a branch created
+        # under a different name still belongs to its original tree.
+        by_id = {e["_id"]: e for e in experiments}
+        by_root = {}
+        for exp in experiments:
+            root_id = (exp.get("refers") or {}).get("root_id") or exp["_id"]
+            by_root.setdefault(root_id, []).append(exp)
+        for root_id, family in sorted(
+            by_root.items(), key=lambda kv: by_id.get(kv[0], kv[1][0])["name"]
+        ):
+            name = by_id.get(root_id, family[0])["name"]
+            print(f"{name}")
+            print("=" * len(name))
+            trials = []
+            for exp in family:
+                trials.extend(storage.fetch_trials(uid=exp["_id"]))
+            body = _trial_lines(trials) if args.all else _status_table(trials)
+            print("\n".join(body) + "\n")
+        return 0
+
     by_name = {}
     for exp in experiments:
         by_name.setdefault(exp["name"], []).append(exp)
 
     for name, versions in sorted(by_name.items()):
-        if getattr(args, "collapse", False):
-            print(f"{name}")
-            print("=" * len(name))
-            trials = []
-            for exp in versions:
-                trials.extend(storage.fetch_trials(uid=exp["_id"]))
-            body = _trial_lines(trials) if args.all else _status_table(trials)
-            print("\n".join(body) + "\n")
-            continue
         for exp in versions:
             title = f"{name}-v{exp.get('version', 1)}"
             print(title)

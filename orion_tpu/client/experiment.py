@@ -1,0 +1,107 @@
+"""Library-level optimization API — no subprocess, no CLI.
+
+The reference exposes `workon` as a library (used in
+tests/functional/demo/test_demo.py "workon as library"); here that surface is
+a first-class `optimize()` driving a python callable directly, plus an
+`ExperimentClient` with suggest/observe for external loops (e.g. evaluating
+a whole q-batch on device at once — the benchmark harness does exactly
+this).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.core.experiment import build_experiment
+from orion_tpu.core.producer import Producer
+from orion_tpu.core.trial import Result
+from orion_tpu.storage.base import create_storage
+from orion_tpu.utils.exceptions import WaitingForTrials
+
+
+class ExperimentClient:
+    """suggest/observe handle over a built experiment."""
+
+    def __init__(self, experiment, max_idle_time=60.0):
+        self.experiment = experiment
+        if experiment.algorithm is None:
+            experiment.instantiate()
+        self.producer = Producer(experiment, max_idle_time=max_idle_time)
+
+    @property
+    def space(self):
+        return self.experiment.space
+
+    def suggest(self, num=1):
+        """Reserve ``num`` trials, producing fresh ones as needed."""
+        out = []
+        self.producer.update()
+        while len(out) < num:
+            trial = self.experiment.reserve_trial()
+            if trial is None:
+                self.producer.produce(num - len(out))
+                trial = self.experiment.reserve_trial()
+                if trial is None:
+                    raise WaitingForTrials("could not reserve after producing")
+            out.append(trial)
+        return out
+
+    def observe(self, trial, objective, **aux_results):
+        results = [Result("objective", "objective", float(objective))]
+        for name, value in aux_results.items():
+            results.append(Result(name, "statistic", value))
+        self.experiment.update_completed_trial(trial, results)
+
+    @property
+    def is_done(self):
+        return self.experiment.is_done
+
+    def stats(self):
+        return self.experiment.stats()
+
+
+def optimize(
+    fn,
+    priors,
+    max_trials=100,
+    batch_size=1,
+    algorithm="random",
+    strategy=None,
+    seed=None,
+    storage=None,
+    name="optimize",
+    batch_eval=None,
+):
+    """Minimize ``fn(params_dict) -> float`` over a prior-DSL space.
+
+    ``batch_eval``: optional vectorized evaluator taking the (n, D) unit-cube
+    jnp array and returning (n,) objectives — keeps whole q-batches on device
+    (used for analytic benchmarks).
+    """
+    storage = storage or create_storage({"type": "memory"})
+    experiment = build_experiment(
+        storage,
+        name,
+        priors=dict(priors),
+        max_trials=max_trials,
+        algorithms=algorithm,
+        strategy=strategy,
+        pool_size=batch_size,
+    ).instantiate(seed=seed)
+    client = ExperimentClient(experiment)
+
+    n_done = 0
+    while n_done < max_trials and not client.is_done:
+        want = min(batch_size, max_trials - n_done)
+        trials = client.suggest(want)
+        if batch_eval is not None:
+            space = experiment.space
+            arrays = space.params_to_arrays([t.params for t in trials])
+            cube = space.encode_flat(arrays)
+            values = np.asarray(batch_eval(jnp.asarray(cube)))
+            for trial, value in zip(trials, values):
+                client.observe(trial, float(value))
+        else:
+            for trial in trials:
+                client.observe(trial, float(fn(trial.params)))
+        n_done += len(trials)
+    return client.stats()

@@ -66,10 +66,12 @@ def _env_config():
         storage["path"] = os.environ["ORION_DB_ADDRESS"]
     if storage:
         out["storage"] = storage
-    for key in ("max_trials", "pool_size", "max_broken"):
+    # Explicit coercions — the DEFAULTS values are None, so their type can't
+    # be used to coerce, and a string max_trials would poison comparisons.
+    for key, cast in (("max_trials", float), ("pool_size", int), ("max_broken", int)):
         env = os.getenv(f"ORION_{key.upper()}")
         if env:
-            out[key] = type(DEFAULTS[key])(env) if DEFAULTS[key] is not None else env
+            out[key] = cast(env)
     return out
 
 

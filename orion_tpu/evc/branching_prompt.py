@@ -45,13 +45,21 @@ class BranchingPrompt(cmd.Cmd):
         """add <dim> [default] — resolve a new dimension with a default."""
         parts = line.split()
         default = _literal(parts[1]) if len(parts) > 1 else None
-        self.builder.add_dimension(parts[0], default)
+        try:
+            self.builder.add_dimension(parts[0], default)
+        except ValueError as exc:
+            # e.g. no default available — report, keep the session (and every
+            # resolution already entered) alive.
+            print(f"cannot resolve: {exc}")
 
     def do_remove(self, line):
         """remove <dim> [default] — drop a missing dimension."""
         parts = line.split()
         default = _literal(parts[1]) if len(parts) > 1 else None
-        self.builder.remove_dimension(parts[0], default)
+        try:
+            self.builder.remove_dimension(parts[0], default)
+        except ValueError as exc:
+            print(f"cannot resolve: {exc}")
 
     def do_rename(self, line):
         """rename <old> <new> — resolve a missing dimension as renamed."""

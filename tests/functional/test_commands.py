@@ -135,3 +135,34 @@ def test_info_unknown_experiment_no_ghost(tmp_path):
         cli_main(["insert", "-n", "typo", *db, "x=1"])
     storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
     assert storage.fetch_experiments({}) == []
+
+
+def test_info_wrong_version_no_ghost(populated):
+    from orion_tpu.utils.exceptions import NoConfigurationError
+
+    tmp_path, db = populated
+    with pytest.raises(NoConfigurationError):
+        cli_main(["info", "-n", "cmd-exp", "--exp-version", "99", *db])
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    assert len(storage.fetch_experiments({"name": "cmd-exp"})) == 1
+
+
+def test_status_collapse_aggregates_tree(populated, capsys):
+    tmp_path, db = populated
+    cli_main(["hunt", "-n", "cmd-exp", *db, "--max-trials", "6", "--worker-trials", "2",
+              BLACK_BOX, "-x~uniform(-10, 10)"])
+    capsys.readouterr()  # drop the hunt's own stats output
+    rc = cli_main(["status", "--collapse", *db])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("cmd-exp") == 1  # one collapsed tree, not per-version
+    assert "6" in out  # 4 (v1) + 2 (v2) completed
+
+
+def test_env_var_coercion(tmp_path, monkeypatch):
+    monkeypatch.setenv("ORION_MAX_TRIALS", "7")
+    from orion_tpu.config import resolve_config
+
+    config = resolve_config()
+    assert config["max_trials"] == 7.0
+    assert isinstance(config["max_trials"], float)

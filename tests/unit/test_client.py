@@ -1,0 +1,85 @@
+"""Library API tests: optimize() and ExperimentClient."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.client.experiment import ExperimentClient, optimize
+from orion_tpu.core.experiment import build_experiment
+from orion_tpu.storage import create_storage
+
+
+def test_optimize_python_callable():
+    stats = optimize(
+        fn=lambda p: (p["x"] - 0.25) ** 2,
+        priors={"x": "uniform(0, 1)"},
+        max_trials=30,
+        batch_size=5,
+        algorithm="random",
+        seed=1,
+    )
+    assert stats["trials_completed"] == 30
+    assert stats["best_evaluation"] < 0.05
+
+
+def test_optimize_batch_eval_on_device():
+    from orion_tpu.benchmarks.functions import branin
+
+    stats = optimize(
+        fn=None,
+        priors={"x0": "uniform(0, 1)", "x1": "uniform(0, 1)"},
+        max_trials=64,
+        batch_size=32,
+        algorithm="random",
+        seed=0,
+        batch_eval=branin,
+    )
+    assert stats["trials_completed"] == 64
+    assert stats["best_evaluation"] < 10.0
+
+
+def test_experiment_client_suggest_observe():
+    storage = create_storage({"type": "memory"})
+    experiment = build_experiment(
+        storage, "cl", priors={"x": "uniform(0, 1)"}, max_trials=10
+    )
+    client = ExperimentClient(experiment)
+    trials = client.suggest(3)
+    assert len(trials) == 3
+    assert all(t.status == "reserved" for t in trials)
+    for i, t in enumerate(trials):
+        client.observe(t, float(i), extra=i * 10)
+    stats = client.stats()
+    assert stats["trials_completed"] == 3
+    assert stats["best_evaluation"] == 0.0
+    # Aux results stored as statistics.
+    best = storage.get_trial(uid=stats["best_trials_id"])
+    assert best.statistics[0].value == 0
+
+
+def test_optimize_with_tpu_bo_converges_better_than_random():
+    from orion_tpu.benchmarks.functions import branin
+
+    priors = {"x0": "uniform(0, 1)", "x1": "uniform(0, 1)"}
+    r = optimize(None, priors, max_trials=64, batch_size=8,
+                 algorithm="random", seed=7, batch_eval=branin)
+    b = optimize(None, priors, max_trials=64, batch_size=8,
+                 algorithm={"tpu_bo": {"n_init": 8, "n_candidates": 512, "fit_steps": 15}},
+                 seed=7, batch_eval=branin)
+    assert b["best_evaluation"] <= r["best_evaluation"] + 1.0
+    assert b["best_evaluation"] < 2.0
+
+
+def test_runner_preset_smoke():
+    from orion_tpu.benchmarks.runner import PRESETS, run_preset
+
+    PRESETS["smoke"] = dict(
+        priors={"x0": "uniform(0, 1)", "x1": "uniform(0, 1)"},
+        fn="branin", algorithm="random", max_trials=20, batch_size=10,
+    )
+    try:
+        out = run_preset("smoke")
+    finally:
+        del PRESETS["smoke"]
+    assert out["trials"] == 20
+    assert out["simple_regret"] is not None
