@@ -209,8 +209,8 @@ class ASHA(BaseAlgorithm):
         bracket_ids = np.minimum(
             np.searchsorted(np.cumsum(probs), draws), len(self.brackets) - 1
         )
-        u = jax.random.uniform(sample_key, (num, self.space.n_cols))
-        arrays = self.space.decode_flat(u)
+        u = np.asarray(jax.random.uniform(sample_key, (num, self.space.n_cols)))
+        arrays = self.space.decode_flat_np(u)
         out = []
         for i, params in enumerate(self.space.arrays_to_params(arrays)):
             bracket_idx = int(bracket_ids[i])

@@ -72,7 +72,10 @@ class BaseAlgorithm:
         cube = self._suggest_cube(num)
         if cube is None:
             return None
-        arrays = self.space.decode_flat(cube)
+        # ONE bulk device->host transfer of the cube, then host-side decode:
+        # per-dimension device decode would pay a host<->device round trip
+        # per dim (orion_tpu.space.dims host codec mirror).
+        arrays = self.space.decode_flat_np(np.asarray(cube))
         return self.space.arrays_to_params(arrays, fidelity_value=self._fidelity_for_new())
 
     def _suggest_cube(self, num):
@@ -94,7 +97,7 @@ class BaseAlgorithm:
         if not params_list:
             return
         arrays = self.space.params_to_arrays(params_list)
-        cube = self.space.encode_flat(arrays)
+        cube = self.space.encode_flat_np(arrays)
         objectives = np.asarray(
             [float(r["objective"]) for r in results], dtype=np.float64
         )

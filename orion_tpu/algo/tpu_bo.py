@@ -20,8 +20,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
-from orion_tpu.algo.gp.acquisition import acquire, joint_thompson
-from orion_tpu.algo.gp.gp import fit_gp
+from orion_tpu.algo.gp.acquisition import (
+    acquire,
+    expected_improvement,
+    joint_thompson,
+    select_q,
+)
+from orion_tpu.algo.gp.gp import fit_gp, init_hypers, posterior_norm
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
 from orion_tpu.parallel import device_mesh, shard_candidates
 
@@ -121,6 +126,51 @@ class TPUBO(BaseAlgorithm):
         n = self._x.shape[0]
         if n < self.n_init:
             return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
+        if self._mesh is not None:
+            # The sharded path keeps separate dispatch stages so candidates
+            # can be placed on the mesh between generation and acquisition.
+            return self._suggest_cube_sharded(num)
+        # Single fused jit call: warm-started GP refit + candidate generation
+        # + acquisition + on-device dedup/EI-fill + gather.  One dispatch and
+        # one (q, d) transfer per suggest — dispatch latency otherwise
+        # dominates (each host->device round trip costs ~ms).
+        n_pad = _next_pow2(n)
+        d = self.space.n_cols
+        x = np.zeros((n_pad, d), dtype=np.float32)
+        y = np.zeros((n_pad,), dtype=np.float32)
+        mask = np.zeros((n_pad,), dtype=np.float32)
+        x[:n] = self._x
+        y[:n] = self._y
+        mask[:n] = 1.0
+        warm = self._gp_state.hypers if self._gp_state is not None else init_hypers(d)
+        best_x = jnp.asarray(self._x[int(np.argmin(self._y))])
+        # Bucket q to a power of two: q is a static arg of the fused jit, and
+        # the producer's retry loop shrinks its request per round — each
+        # distinct q would otherwise recompile the whole fit+acquire graph.
+        q_pad = _next_pow2(num, floor=8)
+        rows, state = _suggest_step(
+            self.next_key(),
+            jnp.asarray(x),
+            jnp.asarray(y),
+            jnp.asarray(mask),
+            best_x,
+            warm,
+            q=q_pad,
+            n_candidates=self.n_candidates,
+            kernel=self.kernel,
+            acq=self.acq,
+            fit_steps=self.fit_steps,
+            local_frac=self.local_frac,
+            local_sigma=self.local_sigma,
+            beta=self.beta,
+        )
+        self._gp_state = state
+        self._gp_dirty = False
+        # Dedup ordered unique draws first, so the first `num` rows are the
+        # ones the un-padded call would have returned.
+        return np.asarray(rows)[:num]
+
+    def _suggest_cube_sharded(self, num):
         state = self._fit()
         key_cand, key_acq = jax.random.split(self.next_key())
         best_x = self._x[int(np.argmin(self._y))]
@@ -132,8 +182,7 @@ class TPUBO(BaseAlgorithm):
             self.local_frac,
             self.local_sigma,
         )
-        if self._mesh is not None:
-            candidates = shard_candidates(candidates, self._mesh)
+        candidates = shard_candidates(candidates, self._mesh)
         if self.acq == "joint_thompson":
             idx = _acquire_joint(key_acq, state, candidates, num, self.kernel)
         else:
@@ -145,25 +194,20 @@ class TPUBO(BaseAlgorithm):
         """A confident posterior makes all Thompson draws argmin at the same
         candidate; q duplicate suggestions would spin the producer on
         DuplicateKeyError.  Keep first occurrences, fill the rest with the
-        top distinct candidates by EI."""
-        seen, out = set(), []
-        for i in np.asarray(idx).tolist():
-            if i not in seen:
-                seen.add(i)
-                out.append(i)
-        if len(out) < num:
+        top distinct candidates by EI.  Vectorized: one np.unique pass per
+        call instead of a python loop over q indices."""
+        idx = np.asarray(idx)
+        _, first = np.unique(idx, return_index=True)
+        out = idx[np.sort(first)]
+        if out.size < num:
             ranked = np.asarray(
                 _acquire(
                     self.next_key(), state, candidates,
                     min(4 * num, candidates.shape[0]), self.kernel, "ei", self.beta,
                 )
             )
-            for i in ranked.tolist():
-                if i not in seen:
-                    seen.add(i)
-                    out.append(i)
-                    if len(out) == num:
-                        break
+            fill = ranked[~np.isin(ranked, out)]
+            out = np.concatenate([out, fill])
         return out[:num]
 
     def _fit(self):
@@ -213,6 +257,81 @@ def _make_candidates(key, n_candidates, n_dims, best_x, local_frac, local_sigma)
     global_c = jax.random.uniform(k1, (n_global, n_dims))
     local_c = best_x[None, :] + local_sigma * jax.random.normal(k2, (n_local, n_dims))
     return jnp.concatenate([global_c, reflect_unit(local_c)], axis=0)
+
+
+def _dedup_fill_device(idx, ei_rank, q):
+    """On-device first-occurrence dedup of ``idx`` with EI-ranked backfill.
+
+    Sort-by-priority-key trick, all static shapes: unique draws keep their
+    draw position as key, duplicates and already-drawn fill candidates get
+    pushed past everything usable, EI fills slot in after the draws.  If the
+    distinct pool is exhausted the tail recycles duplicates (storage
+    dedup/DuplicateKeyError rejects them downstream, as before).
+    """
+    k = ei_rank.shape[0]
+    pos_q = jnp.arange(q)
+    pos_k = jnp.arange(k)
+    is_dup = jnp.any(
+        (idx[:, None] == idx[None, :]) & (pos_q[:, None] > pos_q[None, :]), axis=1
+    )
+    is_member = jnp.any(ei_rank[:, None] == idx[None, :], axis=1)
+    big = q + k + 1
+    key_draws = jnp.where(is_dup, big + pos_q, pos_q)
+    key_fills = jnp.where(is_member, big + q + pos_k, q + pos_k)
+    all_idx = jnp.concatenate([idx, ei_rank])
+    order = jnp.argsort(jnp.concatenate([key_draws, key_fills]))
+    return all_idx[order][:q]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "q",
+        "n_candidates",
+        "kernel",
+        "acq",
+        "fit_steps",
+        "local_frac",
+        "local_sigma",
+        "beta",
+    ),
+)
+def _suggest_step(
+    key,
+    x,
+    y,
+    mask,
+    best_x,
+    warm_hypers,
+    *,
+    q,
+    n_candidates,
+    kernel,
+    acq,
+    fit_steps,
+    local_frac,
+    local_sigma,
+    beta,
+):
+    """The whole GP-BO suggest round as ONE compiled computation."""
+    state = fit_gp(x, y, mask, kind=kernel, n_steps=fit_steps, init=warm_hypers)
+    k_cand, k_acq = jax.random.split(key)
+    candidates = _make_candidates(
+        k_cand, n_candidates, x.shape[1], best_x, local_frac, local_sigma
+    )
+    if acq == "joint_thompson":
+        idx = joint_thompson(k_acq, state, candidates, q, kind=kernel)
+    else:
+        idx = acquire(k_acq, state, candidates, q, kind=kernel, acq=acq, beta=beta)
+    mean, std = posterior_norm(state, candidates, kind=kernel)
+    best = jnp.min(
+        jnp.where(state.mask > 0, (state.y - state.y_mean) / state.y_std, jnp.inf)
+    )
+    ei_rank = select_q(
+        expected_improvement(mean, std, best), min(4 * q, n_candidates)
+    )
+    final_idx = _dedup_fill_device(idx, ei_rank, q)
+    return jnp.take(candidates, final_idx, axis=0), state
 
 
 @partial(jax.jit, static_argnums=(3, 4, 5))

@@ -86,6 +86,18 @@ class Dimension:
         """Inverse of :meth:`decode` (values -> unit cube)."""
         raise NotImplementedError
 
+    # --- host codec mirror --------------------------------------------------
+    # Numpy twins of decode/encode for the host side of the suggest/observe
+    # boundary.  A (q, D) cube is transferred from device ONCE and decoded
+    # host-side; per-dimension device decode would cost one ~ms host<->device
+    # round trip per dimension.  Subclasses override with pure numpy; the
+    # fallback routes through the device codec.
+    def decode_np(self, u):
+        return np.asarray(self.decode(jnp.asarray(u)))
+
+    def encode_np(self, x):
+        return np.asarray(self.encode(jnp.asarray(x)))
+
     # --- host semantics ---------------------------------------------------
     def interval(self):
         raise NotImplementedError
@@ -168,14 +180,61 @@ class Real(Dimension):
             raise NotImplementedError(f"prior {self.dist!r}")
         return jnp.clip(u, 0.0, 1.0)
 
+    def decode_np(self, u):
+        from scipy.special import ndtr as _ndtr, ndtri as _ndtri
+
+        u = np.clip(np.asarray(u, dtype=np.float64), _EPS, 1.0 - _EPS)
+        if self.dist == "uniform":
+            return self.low + u * (self.high - self.low)
+        if self.dist == "loguniform":
+            lo, hi = math.log(self.low), math.log(self.high)
+            return np.exp(lo + u * (hi - lo))
+        if self.dist == "normal":
+            if math.isfinite(self.low) or math.isfinite(self.high):
+                a = _ndtr((self.low - self.loc) / self.scale)
+                b = _ndtr((self.high - self.loc) / self.scale)
+                u = np.clip(a + u * (b - a), _EPS, 1.0 - _EPS)
+            return self.loc + self.scale * _ndtri(u)
+        raise NotImplementedError(f"prior {self.dist!r}")
+
+    def encode_np(self, x):
+        from scipy.special import ndtr as _ndtr
+
+        x = np.asarray(x, dtype=np.float64)
+        if self.dist == "uniform":
+            u = (x - self.low) / (self.high - self.low)
+        elif self.dist == "loguniform":
+            lo, hi = math.log(self.low), math.log(self.high)
+            u = (np.log(x) - lo) / (hi - lo)
+        elif self.dist == "normal":
+            u = _ndtr((x - self.loc) / self.scale)
+            if math.isfinite(self.low) or math.isfinite(self.high):
+                a = _ndtr((self.low - self.loc) / self.scale)
+                b = _ndtr((self.high - self.loc) / self.scale)
+                u = (u - a) / (b - a)
+        else:
+            raise NotImplementedError(f"prior {self.dist!r}")
+        return np.clip(u, 0.0, 1.0)
+
     def cast(self, value):
+        arr = self._cast_arr(value)
+        return arr.reshape(self.shape) if self.shape else float(arr)
+
+    def _cast_arr(self, value):
         arr = np.asarray(value, dtype=float)
         if self.precision:
             with np.errstate(divide="ignore"):
                 mag = np.where(arr != 0, np.floor(np.log10(np.abs(arr))), 0.0)
             factor = 10.0 ** (self.precision - 1 - mag)
             arr = np.round(arr * factor) / factor
-        return arr.reshape(self.shape) if self.shape else float(arr)
+        return arr
+
+    def cast_column(self, col):
+        """Vectorized scalar cast of a length-n column -> python list.
+
+        One numpy pass per column instead of a python-level ``cast`` call per
+        value — this is on the q=1024 suggest hot path (arrays_to_params)."""
+        return self._cast_arr(col).tolist()
 
     def __contains__(self, value):
         try:
@@ -221,6 +280,33 @@ class Integer(Real):
     def cast(self, value):
         arr = np.floor(np.asarray(value, dtype=float)).astype(int)
         return arr.reshape(self.shape) if self.shape else int(arr)
+
+    def cast_column(self, col):
+        return np.floor(np.asarray(col, dtype=float)).astype(int).tolist()
+
+    def decode_np(self, u):
+        u = np.clip(np.asarray(u, dtype=np.float64), _EPS, 1.0 - _EPS)
+        if self.dist == "uniform":
+            span = self.high - self.low + 1
+            x = np.floor(self.low + u * span)
+        elif self.dist == "loguniform":
+            lo, hi = math.log(self.low), math.log(self.high + 1)
+            x = np.floor(np.exp(lo + u * (hi - lo)))
+        else:
+            x = np.floor(super().decode_np(u))
+        return np.clip(x, self.low, self.high).astype(np.int32)
+
+    def encode_np(self, x):
+        x = np.asarray(x, dtype=np.float64)
+        if self.dist == "uniform":
+            span = self.high - self.low + 1
+            u = (x - self.low + 0.5) / span
+        elif self.dist == "loguniform":
+            lo, hi = math.log(self.low), math.log(self.high + 1)
+            u = (np.log(x + 0.5) - lo) / (hi - lo)
+        else:
+            u = super().encode_np(x + 0.5)
+        return np.clip(u, 0.0, 1.0)
 
     def __contains__(self, value):
         try:
@@ -269,6 +355,17 @@ class Categorical(Dimension):
         cum = np.concatenate([[0.0], np.cumsum(np.asarray(self.probs))])
         mid = jnp.asarray((cum[:-1] + cum[1:]) / 2.0, dtype=jnp.float32)
         return mid[jnp.asarray(idx, dtype=jnp.int32)]
+
+    def decode_np(self, u):
+        u = np.clip(np.asarray(u, dtype=np.float64), _EPS, 1.0 - _EPS)
+        cum = np.cumsum(np.asarray(self.probs, dtype=np.float64))
+        idx = np.searchsorted(cum, u)
+        return np.clip(idx, 0, self.n_choices - 1).astype(np.int32)
+
+    def encode_np(self, idx):
+        cum = np.concatenate([[0.0], np.cumsum(np.asarray(self.probs))])
+        mid = (cum[:-1] + cum[1:]) / 2.0
+        return mid[np.asarray(idx, dtype=np.int32)]
 
     def to_index(self, value):
         """Host: category object -> index."""

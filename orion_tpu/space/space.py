@@ -20,6 +20,7 @@ class Space:
 
     def __init__(self, dims=()):
         self._dims = {}
+        self._decode_jit = None
         for dim in dims:
             self.register(dim)
 
@@ -31,6 +32,7 @@ class Space:
             raise ValueError(f"Duplicate dimension name {dim.name!r}")
         self._dims[dim.name] = dim
         self._dims = dict(sorted(self._dims.items()))
+        self._decode_jit = None
 
     def __iter__(self):
         return iter(self._dims.values())
@@ -113,10 +115,20 @@ class Space:
         return out
 
     def decode_flat(self, u):
-        """(n, D) unit cube -> dict of per-dim device arrays (pure jnp).
+        """(n, D) unit cube -> dict of per-dim device arrays.
 
         Categorical values are integer indices; fidelity dims are absent.
+        Jitted as one compiled function per input shape: the per-dim codec is
+        ~5 small ops per dimension and dispatch latency would otherwise
+        dominate the q=1024 suggest path.
         """
+        if self.n_cols == 0:
+            return {}
+        if self._decode_jit is None:
+            self._decode_jit = jax.jit(self._decode_flat_impl)
+        return self._decode_jit(u)
+
+    def _decode_flat_impl(self, u):
         slices = self._col_slices()
         out = {}
         for dim in self:
@@ -145,6 +157,41 @@ class Space:
             return jnp.zeros((0, 0))
         return jnp.concatenate(cols, axis=1)
 
+    # --- host codec mirror --------------------------------------------------
+    # Numpy twins of decode_flat/encode_flat for the host side of the
+    # suggest/observe boundary (one bulk device transfer + cheap host math
+    # instead of per-dimension device dispatches — see Dimension.decode_np).
+    def decode_flat_np(self, u):
+        u = np.asarray(u)
+        slices = self._col_slices()
+        out = {}
+        for dim in self:
+            if dim.n_cols == 0:
+                continue
+            a, b = slices[dim.name]
+            vals = dim.decode_np(u[:, a:b])
+            if dim.shape:
+                vals = vals.reshape((u.shape[0],) + dim.shape)
+            else:
+                vals = vals[:, 0]
+            out[dim.name] = vals
+        return out
+
+    def encode_flat_np(self, arrays):
+        cols = []
+        for dim in self:
+            if dim.n_cols == 0:
+                continue
+            vals = np.asarray(arrays[dim.name])
+            cols.append(
+                dim.encode_np(vals.reshape(vals.shape[0], dim.size)).astype(
+                    np.float32
+                )
+            )
+        if not cols:
+            return np.zeros((0, 0), dtype=np.float32)
+        return np.concatenate(cols, axis=1)
+
     def sample_flat(self, key, n):
         """Prior sampling = uniform cube (encode is each prior's CDF)."""
         return jax.random.uniform(key, (n, self.n_cols))
@@ -158,25 +205,33 @@ class Space:
         """
         host = {k: np.asarray(v) for k, v in arrays.items()}
         n = next(iter(host.values())).shape[0] if host else 0
-        out = []
-        for i in range(n):
-            params = {}
-            for dim in self:
-                if isinstance(dim, Fidelity):
-                    params[dim.name] = int(
-                        fidelity_value if fidelity_value is not None else dim.high
-                    )
-                    continue
-                val = host[dim.name][i]
-                if isinstance(dim, Categorical):
-                    params[dim.name] = dim.from_index(val)
+        # Columnar conversion: one vectorized pass per dimension, then zip
+        # rows into dicts — python-loop-per-value would dominate q=1024
+        # suggest calls.
+        names, columns = [], []
+        for dim in self:
+            names.append(dim.name)
+            if isinstance(dim, Fidelity):
+                fv = int(fidelity_value if fidelity_value is not None else dim.high)
+                columns.append([fv] * n)
+                continue
+            col = host[dim.name]
+            if isinstance(dim, Categorical):
+                if dim.shape:
+                    columns.append([dim.from_index(row) for row in col])
                 else:
-                    params[dim.name] = dim.cast(val)
-            out.append(params)
-        return out
+                    cats = dim.categories
+                    columns.append([cats[int(i)] for i in col.tolist()])
+            elif dim.shape:
+                columns.append([dim.cast(row) for row in col])
+            else:
+                columns.append(dim.cast_column(col))
+        return [dict(zip(names, row)) for row in zip(*columns)] if names else []
 
     def params_to_arrays(self, params_list):
-        """List of structured param dicts -> dict of device-ready arrays."""
+        """List of structured param dicts -> dict of host numpy arrays
+        (device-ready: jnp.asarray is a cheap upload when a jitted consumer
+        wants them)."""
         out = {}
         for dim in self:
             if isinstance(dim, Fidelity):
@@ -185,7 +240,7 @@ class Space:
                 vals = np.asarray([dim.to_index(p[dim.name]) for p in params_list])
             else:
                 vals = np.asarray([p[dim.name] for p in params_list], dtype=float)
-            out[dim.name] = jnp.asarray(vals)
+            out[dim.name] = vals
         return out
 
     def sample(self, key_or_seed, n=1, fidelity_value=None):
