@@ -13,6 +13,7 @@ import yaml
 from orion_tpu.config import resolve_config
 from orion_tpu.core.experiment import build_experiment
 from orion_tpu.io.cmdline import CommandLineParser
+from orion_tpu.io.versioning import hash_config_file, infer_versioning_metadata
 from orion_tpu.storage.base import setup_storage
 from orion_tpu.utils.exceptions import NoConfigurationError
 
@@ -62,6 +63,8 @@ def load_cli_config(args):
             "pool_size": getattr(args, "pool_size", None),
             "working_dir": getattr(args, "working_dir", None),
             "max_broken": getattr(args, "max_broken", None),
+            "heartbeat": getattr(args, "heartbeat", None),
+            "max_idle_time": getattr(args, "max_idle_time", None),
         }.items()
         if value is not None
     }
@@ -87,6 +90,7 @@ def build_from_args(args, need_user_args=True, allow_create=True):
     parser = CommandLineParser(config_prefix=config.get("user_script_config", "config"))
     user_args = list(getattr(args, "user_args", []) or [])
     priors = parser.parse(user_args)
+    existing = []
     if not allow_create or (need_user_args and not user_args):
         # Check BEFORE build_experiment would persist an empty experiment —
         # including the requested version, or a typo'd --exp-version would
@@ -104,9 +108,43 @@ def build_from_args(args, need_user_args=True, allow_create=True):
                 "a user script command is required for a new experiment"
             )
 
+    if not allow_create:
+        # Read-only commands (info/status/insert) must never branch: their
+        # user_args are not a command line (insert passes `x=1.2`
+        # assignments) and a lookup must not mutate the experiment tree —
+        # so pass NO config at all, only the identity.
+        experiment = build_experiment(
+            storage, config["name"], version=config.get("version")
+        )
+        return experiment, parser
+
     metadata = {"user_args": user_args, "parser_state": parser.state_dict()}
+    script_path = None
+    config_file_path = parser.config_file_path
     if user_args:
-        metadata["user_script"] = os.path.abspath(user_args[0])
+        script_path = os.path.abspath(user_args[0])
+        metadata["user_script"] = script_path
+    else:
+        # Argless resume (`hunt -n name`): the code identity must still be
+        # checked, or edits to the stored script silently contaminate the
+        # old version.  Recover the script/config paths from the stored
+        # experiment (fetched above when user_args is empty; resume targets
+        # the latest version).
+        stored_meta = {}
+        if existing:
+            latest = max(existing, key=lambda d: d.get("version", 1))
+            stored_meta = latest.get("metadata") or {}
+        script_path = stored_meta.get("user_script")
+        stored_parser = stored_meta.get("parser_state") or {}
+        config_file_path = config_file_path or stored_parser.get("config_file_path")
+    if script_path:
+        vcs = infer_versioning_metadata(script_path)
+        if vcs is not None:
+            metadata["vcs"] = vcs
+    if config_file_path:
+        config_hash = hash_config_file(config_file_path)
+        if config_hash is not None:
+            metadata["script_config_hash"] = config_hash
     experiment = build_experiment(
         storage,
         config["name"],
@@ -120,6 +158,14 @@ def build_from_args(args, need_user_args=True, allow_create=True):
         algorithms=config.get("algorithms"),
         strategy=config.get("strategy"),
         branch_config={"manual_resolution": getattr(args, "manual_resolution", False)},
+    )
+    # Worker-level knobs, not part of the experiment's stored identity
+    # (reference keeps them in the global worker config, `core/__init__.py:93`):
+    # heartbeat governs this worker's lost-trial sweep threshold,
+    # max_idle_time its producer stall budget (consumed by workon).
+    experiment.heartbeat = float(config.get("heartbeat", experiment.heartbeat))
+    experiment.max_idle_time = float(
+        config.get("max_idle_time", experiment.max_idle_time)
     )
     # Resuming: rebuild the parser from the stored experiment metadata so the
     # original template (and config file) is used even without user args.

@@ -25,6 +25,18 @@ def add_subparser(subparsers):
     group.add_argument("--pool-size", type=int, default=None, help="suggestions per producer round")
     group.add_argument("--working-dir", default=None, help="permanent trial working directory")
     group.add_argument("--max-broken", type=int, default=None, help="broken-trial budget")
+    group.add_argument(
+        "--heartbeat",
+        type=float,
+        default=None,
+        help="seconds before a silent reserved trial counts as lost",
+    )
+    group.add_argument(
+        "--max-idle-time",
+        type=float,
+        default=None,
+        help="seconds the producer may go without registering a new point",
+    )
     parser.set_defaults(func=main)
     return parser
 
@@ -33,7 +45,15 @@ def main(args):
     experiment, parser = build_from_args(args)
     experiment.instantiate()
     try:
-        workon(experiment, parser, worker_trials=args.worker_trials)
+        workon(
+            experiment,
+            parser,
+            worker_trials=args.worker_trials,
+            max_idle_time=experiment.max_idle_time,
+            # Pacemaker must beat the sweep threshold comfortably or live
+            # trials get recovered as lost.
+            heartbeat_interval=experiment.heartbeat / 2.0,
+        )
     except BrokenExperiment as exc:
         print(f"Error: {exc}", file=sys.stderr)
         return 1

@@ -42,6 +42,7 @@ class Experiment:
         self.max_trials = config.get("max_trials", float("inf"))
         self.max_broken = config.get("max_broken", DEFAULT_MAX_BROKEN)
         self.heartbeat = config.get("heartbeat", DEFAULT_HEARTBEAT)
+        self.max_idle_time = config.get("max_idle_time", 60.0)
         self.pool_size = config.get("pool_size", DEFAULT_POOL_SIZE)
         self.working_dir = config.get("working_dir")
         self.algo_config = config.get("algorithms", "random")
@@ -226,21 +227,27 @@ def build_experiment(
                         f"lost creation race for experiment {name!r} twice"
                     )
                 continue  # someone else created it — reload
-        # Resume path.  Branch when the search space changed, or when an
-        # explicitly-given algorithm config differs from the stored one
-        # (an omitted algorithms key means "resume as stored", never a
-        # silent downgrade to the default).
+        # Resume path.  Branch when anything identity-bearing changed: the
+        # search space, an explicitly-given algorithm config (an omitted
+        # algorithms key means "resume as stored", never a silent downgrade
+        # to the default), the user script's VCS state, its config file
+        # hash, or its non-prior command line.  The same detector drives the
+        # branch itself, so the gate and the branching can never disagree.
         exp = Experiment(storage, existing)
-        priors_changed = bool(priors) and dict(priors) != exp.priors
-        new_algo = config.get("algorithms")
-        algo_changed = new_algo is not None and new_algo != exp.algo_config
-        if priors_changed or algo_changed:
-            from orion_tpu.evc.builder import branch_experiment
+        from orion_tpu.evc.builder import branch_experiment
+        from orion_tpu.evc.conflicts import detect_conflicts
 
+        candidate = {
+            "name": name,
+            "priors": dict(priors) if priors else dict(exp.priors),
+            "algorithms": config.get("algorithms"),
+            "metadata": config.get("metadata") or {},
+        }
+        if detect_conflicts(exp.configuration(), candidate).conflicts:
             return branch_experiment(
                 storage,
                 exp,
-                dict(priors) if priors else dict(exp.priors),
+                candidate["priors"],
                 branch_config=branch_config,
                 **config,
             )

@@ -166,11 +166,20 @@ def branch_experiment(storage, parent, new_priors, branch_config=None, **config)
             "must still leave at least one dimension"
         )
 
+    # A branch created without a fresh command line (argless resume that hit
+    # a CodeConflict) must inherit the parent's command metadata or the child
+    # could never be run.
+    new_meta = dict(config.get("metadata") or {})
+    if not new_meta.get("user_args"):
+        parent_meta = parent.metadata or {}
+        for key in ("user_args", "parser_state", "user_script"):
+            if parent_meta.get(key) is not None:
+                new_meta[key] = parent_meta[key]
     child_config = {
         "name": child_name,
         "version": child_version,
         "priors": clean_priors,
-        "metadata": {"timestamp": time.time(), **config.get("metadata", {})},
+        "metadata": {"timestamp": time.time(), **new_meta},
         "max_trials": config.get("max_trials", parent.max_trials),
         "max_broken": config.get("max_broken", parent.max_broken),
         "pool_size": config.get("pool_size", parent.pool_size),

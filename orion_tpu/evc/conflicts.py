@@ -303,10 +303,15 @@ def detect_conflicts(old_config, new_config):
 
     old_meta = old_config.get("metadata", {})
     new_meta = new_config.get("metadata", {})
-    old_sha = (old_meta.get("vcs") or {}).get("HEAD_sha")
-    new_sha = (new_meta.get("vcs") or {}).get("HEAD_sha")
-    if old_sha and new_sha and old_sha != new_sha:
-        conflicts.add(CodeConflict(old_sha, new_sha))
+    old_vcs = old_meta.get("vcs") or {}
+    new_vcs = new_meta.get("vcs") or {}
+    # Code identity = (HEAD sha, uncommitted-diff sha): two dirty checkouts at
+    # the same HEAD with different edits are different code (reference
+    # `resolve_config.py:270-282`, `conflicts.py:1083`).
+    old_sig = (old_vcs.get("HEAD_sha"), old_vcs.get("diff_sha"))
+    new_sig = (new_vcs.get("HEAD_sha"), new_vcs.get("diff_sha"))
+    if any(old_sig) and any(new_sig) and old_sig != new_sig:
+        conflicts.add(CodeConflict(old_sig, new_sig))
 
     old_cli = _non_prior_args(old_meta.get("user_args", []))
     new_cli = _non_prior_args(new_meta.get("user_args", []))

@@ -37,3 +37,134 @@ def test_resume_same_config_does_not_branch(tmp_path):
               BLACK_BOX, "-x~uniform(-50, 50)"])
     storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
     assert len(storage.fetch_experiments({"name": "same"})) == 1
+
+
+_CONFIG_BOX = """#!/usr/bin/env python
+import argparse
+from orion_tpu.client import report_results
+
+p = argparse.ArgumentParser()
+p.add_argument("-x", type=float, required=True)
+p.add_argument("--config")
+a = p.parse_args()
+report_results([{"name": "objective", "type": "objective", "value": a.x ** 2}])
+"""
+
+
+def _git(repo, *argv):
+    import subprocess
+
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.name=t", "-c", "user.email=t@t", *argv],
+        check=True,
+        capture_output=True,
+    )
+
+
+def test_hunt_branches_on_code_change(tmp_path):
+    """Editing + committing the user script between hunts -> CodeConflict ->
+    version bump (reference `conflicts.py:1083`, `resolve_config.py:249-289`)."""
+    repo = tmp_path / "proj"
+    repo.mkdir()
+    script = repo / "box.py"
+    script.write_text(_CONFIG_BOX)
+    script.chmod(0o755)
+    _git(repo, "init", "-q")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "v1")
+
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    args = ["--max-trials", "2", "--worker-trials", "2", str(script),
+            "-x~uniform(-5, 5)"]
+    cli_main(["hunt", "-n", "code", *db, *args])
+    script.write_text(_CONFIG_BOX + "\n# changed\n")
+    _git(repo, "commit", "-aqm", "v2")
+    rc = cli_main(["hunt", "-n", "code", *db, *args])
+    assert rc == 0
+
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exps = {e["version"]: e for e in storage.fetch_experiments({"name": "code"})}
+    assert set(exps) == {1, 2}
+    assert exps[1]["metadata"]["vcs"]["HEAD_sha"] != exps[2]["metadata"]["vcs"]["HEAD_sha"]
+    adapter = exps[2]["refers"]["adapter"]
+    assert adapter["of_type"] == "compositeadapter"
+    assert any(a["of_type"] == "codechange" for a in adapter["adapters"])
+
+
+def test_hunt_branches_on_script_config_change(tmp_path):
+    """Editing the user script's templated config file between hunts ->
+    ScriptConfigConflict -> version bump (reference `conflicts.py:1334`)."""
+    script = tmp_path / "box.py"
+    script.write_text(_CONFIG_BOX)
+    script.chmod(0o755)
+    conf = tmp_path / "settings.yaml"
+    conf.write_text("fixed: 1\n")
+
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    args = ["--max-trials", "2", "--worker-trials", "2", str(script),
+            "-x~uniform(-5, 5)", "--config", str(conf)]
+    cli_main(["hunt", "-n", "sconf", *db, *args])
+    conf.write_text("fixed: 2\n")
+    rc = cli_main(["hunt", "-n", "sconf", *db, *args])
+    assert rc == 0
+
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exps = {e["version"]: e for e in storage.fetch_experiments({"name": "sconf"})}
+    assert set(exps) == {1, 2}
+    h1 = exps[1]["metadata"]["script_config_hash"]
+    h2 = exps[2]["metadata"]["script_config_hash"]
+    assert h1 and h2 and h1 != h2
+
+
+def test_argless_resume_detects_code_change(tmp_path):
+    """`hunt -n name` with no command line must still branch when the stored
+    script's git state changed, and the child must inherit a runnable command
+    (user_args/parser_state) from the parent."""
+    repo = tmp_path / "proj"
+    repo.mkdir()
+    script = repo / "box.py"
+    script.write_text(_CONFIG_BOX)
+    script.chmod(0o755)
+    _git(repo, "init", "-q")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "v1")
+
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    cli_main(["hunt", "-n", "argless", *db, "--max-trials", "2",
+              "--worker-trials", "2", str(script), "-x~uniform(-5, 5)"])
+    script.write_text(_CONFIG_BOX + "\n# changed\n")
+    _git(repo, "commit", "-aqm", "v2")
+    rc = cli_main(["hunt", "-n", "argless", *db, "--max-trials", "2",
+                   "--worker-trials", "2"])
+    assert rc == 0
+
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exps = {e["version"]: e for e in storage.fetch_experiments({"name": "argless"})}
+    assert set(exps) == {1, 2}
+    child_meta = exps[2]["metadata"]
+    assert child_meta["user_args"], "child must inherit the parent's command"
+    assert [t for t in storage.fetch_trials(uid=exps[2]["_id"])
+            if t.status == "completed"]
+
+
+def test_untracked_file_addition_branches(tmp_path):
+    """`git diff HEAD` is blind to untracked files; the signature must not be."""
+    repo = tmp_path / "proj"
+    repo.mkdir()
+    script = repo / "box.py"
+    script.write_text(_CONFIG_BOX)
+    script.chmod(0o755)
+    _git(repo, "init", "-q")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "v1")
+
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    args = ["--max-trials", "2", "--worker-trials", "2", str(script),
+            "-x~uniform(-5, 5)"]
+    cli_main(["hunt", "-n", "untracked", *db, *args])
+    (repo / "helper.py").write_text("VALUE = 3\n")  # untracked, never committed
+    rc = cli_main(["hunt", "-n", "untracked", *db, *args])
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    versions = {e["version"] for e in storage.fetch_experiments({"name": "untracked"})}
+    assert versions == {1, 2}

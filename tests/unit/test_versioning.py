@@ -1,0 +1,77 @@
+"""VCS + script-config metadata capture (reference
+`src/orion/core/io/resolve_config.py:249-289`)."""
+
+import subprocess
+
+import pytest
+
+from orion_tpu.io.versioning import hash_config_file, infer_versioning_metadata
+
+
+def _git(repo, *argv):
+    subprocess.run(
+        ["git", "-C", str(repo), "-c", "user.name=t", "-c", "user.email=t@t", *argv],
+        check=True,
+        capture_output=True,
+    )
+
+
+@pytest.fixture
+def script_repo(tmp_path):
+    repo = tmp_path / "proj"
+    repo.mkdir()
+    script = repo / "box.py"
+    script.write_text("print('v1')\n")
+    _git(repo, "init", "-q")
+    _git(repo, "add", ".")
+    _git(repo, "commit", "-qm", "v1")
+    return repo, script
+
+
+def test_captures_head_sha_branch_and_clean_state(script_repo):
+    repo, script = script_repo
+    meta = infer_versioning_metadata(str(script))
+    assert meta["type"] == "git"
+    assert len(meta["HEAD_sha"]) == 40
+    assert meta["active_branch"] in ("main", "master")
+    assert meta["is_dirty"] is False
+    assert meta["diff_sha"] is None
+
+
+def test_dirty_edit_changes_diff_sha_not_head(script_repo):
+    repo, script = script_repo
+    clean = infer_versioning_metadata(str(script))
+    script.write_text("print('v2')\n")
+    dirty = infer_versioning_metadata(str(script))
+    assert dirty["is_dirty"] is True
+    assert dirty["HEAD_sha"] == clean["HEAD_sha"]
+    assert dirty["diff_sha"] is not None
+    script.write_text("print('v3')\n")
+    dirty2 = infer_versioning_metadata(str(script))
+    assert dirty2["diff_sha"] != dirty["diff_sha"]
+
+
+def test_commit_changes_head_sha(script_repo):
+    repo, script = script_repo
+    before = infer_versioning_metadata(str(script))
+    script.write_text("print('v2')\n")
+    _git(repo, "commit", "-aqm", "v2")
+    after = infer_versioning_metadata(str(script))
+    assert after["HEAD_sha"] != before["HEAD_sha"]
+    assert after["is_dirty"] is False
+
+
+def test_outside_repo_returns_none(tmp_path):
+    script = tmp_path / "standalone.py"
+    script.write_text("print('x')\n")
+    assert infer_versioning_metadata(str(script)) is None
+
+
+def test_hash_config_file_tracks_content(tmp_path):
+    conf = tmp_path / "c.yaml"
+    conf.write_text("lr: 0.1\n")
+    h1 = hash_config_file(str(conf))
+    conf.write_text("lr: 0.2\n")
+    h2 = hash_config_file(str(conf))
+    assert h1 and h2 and h1 != h2
+    assert hash_config_file(str(tmp_path / "missing.yaml")) is None
