@@ -23,9 +23,25 @@ def add_subparser(subparsers):
     sub = parser.add_subparsers(dest="db_command", metavar="ACTION")
 
     setup_p = sub.add_parser("setup", help="write the user configuration file")
-    setup_p.add_argument("--storage-type", default="pickled", choices=["pickled", "memory"])
+    setup_p.add_argument(
+        "--storage-type", default="pickled", choices=["pickled", "memory", "network"]
+    )
     setup_p.add_argument("--path", default=None, help="pickled DB file path")
+    setup_p.add_argument("--host", default="127.0.0.1", help="network DB host")
+    setup_p.add_argument("--port", type=int, default=8765, help="network DB port")
     setup_p.set_defaults(func=main_setup)
+
+    serve_p = sub.add_parser(
+        "serve", help="run the shared network DB server (multi-node storage)"
+    )
+    serve_p.add_argument("--host", default="0.0.0.0", help="bind address")
+    serve_p.add_argument("--port", type=int, default=8765, help="bind port")
+    serve_p.add_argument(
+        "--persist",
+        default=None,
+        help="snapshot file so the server can restart without losing state",
+    )
+    serve_p.set_defaults(func=main_serve)
 
     test_p = sub.add_parser("test", help="run staged storage checks")
     _common(test_p)
@@ -45,11 +61,21 @@ def _common(parser):
     parser.add_argument("--debug", action="store_true")
 
 
+def main_serve(args):
+    from orion_tpu.storage.netdb import serve
+
+    serve(host=args.host, port=args.port, persist=args.persist)
+    return 0
+
+
 def main_setup(args):
     path = user_config_path()
     os.makedirs(os.path.dirname(path), exist_ok=True)
     storage = {"type": args.storage_type}
-    if args.path:
+    if args.storage_type == "network":
+        storage["host"] = args.host
+        storage["port"] = args.port
+    elif args.path:
         storage["path"] = os.path.abspath(args.path)
     elif args.storage_type == "pickled":
         storage["path"] = os.path.join(

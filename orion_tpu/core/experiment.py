@@ -42,7 +42,7 @@ class Experiment:
         self.max_trials = config.get("max_trials", float("inf"))
         self.max_broken = config.get("max_broken", DEFAULT_MAX_BROKEN)
         self.heartbeat = config.get("heartbeat", DEFAULT_HEARTBEAT)
-        self.max_idle_time = config.get("max_idle_time", 60.0)
+        self.max_idle_time = config.get("max_idle_time", DEFAULT_MAX_IDLE_TIME)
         self.pool_size = config.get("pool_size", DEFAULT_POOL_SIZE)
         self.working_dir = config.get("working_dir")
         self.algo_config = config.get("algorithms", "random")
@@ -208,12 +208,18 @@ def build_experiment(
     for attempt in range(2):
         existing = _fetch_config(storage, name, version)
         if existing is None:
+            # Non-mutating read of metadata: on a lost creation race the SAME
+            # config dict feeds the resume path below, where popped metadata
+            # would silently disable code/CLI conflict detection.
             full = {
                 "name": name,
                 "version": version or 1,
                 "priors": dict(priors or {}),
-                "metadata": {"timestamp": time.time(), **config.pop("metadata", {})},
-                **config,
+                "metadata": {
+                    "timestamp": time.time(),
+                    **(config.get("metadata") or {}),
+                },
+                **{k: v for k, v in config.items() if k != "metadata"},
             }
             full.setdefault("algorithms", "random")
             full.setdefault("strategy", "MaxParallelStrategy")

@@ -20,7 +20,11 @@ log = logging.getLogger(__name__)
 
 
 class Producer:
-    def __init__(self, experiment, max_idle_time=60.0):
+    def __init__(self, experiment, max_idle_time=None):
+        from orion_tpu.core.experiment import DEFAULT_MAX_IDLE_TIME
+
+        if max_idle_time is None:
+            max_idle_time = DEFAULT_MAX_IDLE_TIME
         if experiment.algorithm is None:
             raise RuntimeError("Experiment not instantiated (call instantiate())")
         self.experiment = experiment

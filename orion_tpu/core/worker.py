@@ -13,6 +13,7 @@ import io
 import logging
 
 from orion_tpu.core.consumer import Consumer
+from orion_tpu.core.experiment import DEFAULT_HEARTBEAT, DEFAULT_MAX_IDLE_TIME
 from orion_tpu.core.producer import Producer
 from orion_tpu.utils.exceptions import BrokenExperiment, SampleTimeout, WaitingForTrials
 
@@ -39,8 +40,8 @@ def workon(
     experiment,
     cmdline_parser,
     worker_trials=None,
-    max_idle_time=60.0,
-    heartbeat_interval=60.0,
+    max_idle_time=DEFAULT_MAX_IDLE_TIME,
+    heartbeat_interval=DEFAULT_HEARTBEAT / 2.0,
     on_error=None,
 ):
     """Run the optimization loop for up to `worker_trials` trials."""

@@ -57,10 +57,20 @@ def infer_versioning_metadata(script_path):
     branch = _git(repo_dir, "rev-parse", "--abbrev-ref", "HEAD")
     status = _git(repo_dir, "status", "--porcelain")
     diff = _git(repo_dir, "diff", "HEAD") if head_sha else _git(repo_dir, "diff")
-    # The working-tree hash covers the tracked diff AND the status listing:
-    # `git diff HEAD` is blind to untracked files, but adding (or removing)
-    # an untracked module the script imports is still a code change.
-    dirty_state = (diff or "") + "\0" + (status or "")
+    # The working-tree hash covers the tracked diff, the status listing, AND
+    # the CONTENT of untracked files next to the script: `git diff HEAD` is
+    # blind to untracked files and the status listing only names them, but an
+    # edited untracked helper the script imports is still a code change.
+    # (Untracked files elsewhere in the repo appear in `status` by name only.)
+    parts = [diff or "", status or ""]
+    untracked = _git(repo_dir, "ls-files", "--others", "--exclude-standard")
+    for rel in (untracked or "").splitlines():
+        try:
+            with open(os.path.join(repo_dir, rel), "rb") as handle:
+                parts.append(rel + hashlib.sha256(handle.read()).hexdigest())
+        except OSError:
+            parts.append(rel)
+    dirty_state = "\0".join(parts)
     diff_sha = (
         hashlib.sha256(dirty_state.encode()).hexdigest()
         if dirty_state.strip("\0")

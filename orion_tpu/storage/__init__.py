@@ -8,6 +8,8 @@ is to this framework (see SURVEY.md §2.3/§5): all inter-worker communication
 - ``memory`` — in-process, for tests/--debug (reference EphemeralDB).
 - ``pickled`` — single file + advisory file lock, multi-process safe on one
   node (reference PickledDB); the default.
+- ``network`` — TCP client to an `orion-tpu db serve` server, multi-NODE
+  safe over DCN (reference MongoDB driver; see ``orion_tpu.storage.netdb``).
 
 Intra-suggest parallelism (on-device vmap/shard_map over a TPU mesh) is a
 *different* layer — see ``orion_tpu.parallel``.
@@ -23,11 +25,14 @@ from orion_tpu.storage.base import (
 )
 from orion_tpu.storage.documents import MemoryDB
 from orion_tpu.storage.backends import PickledDB
+from orion_tpu.storage.netdb import DBServer, NetworkDB
 
 __all__ = [
     "BaseStorage",
+    "DBServer",
     "DocumentStorage",
     "MemoryDB",
+    "NetworkDB",
     "PickledDB",
     "ReadOnlyStorage",
     "create_storage",

@@ -295,6 +295,30 @@ class ReadOnlyStorage:
         return getattr(self._storage, name)
 
 
+def _parse_network_address(config):
+    """(host, port) from a network-storage config; ``address``/``path`` may
+    carry ``host[:port]`` (the ORION_DB_ADDRESS env form)."""
+    host = config.get("host", "127.0.0.1")
+    port = config.get("port", 8765)
+    address = config.get("address")
+    if not address and "host" not in config and "port" not in config:
+        # `path` doubles as ORION_DB_ADDRESS, but only when host/port are not
+        # given: the layered config merge leaks the DEFAULTS pickled path into
+        # a network storage section, and it must not hijack the address.
+        address = config.get("path")
+    if address:
+        address = str(address)
+        if ":" in address:
+            host, _, port = address.rpartition(":")
+            if not host or not port:
+                raise DatabaseError(
+                    f"bad network DB address {address!r}; expected host:port"
+                )
+        else:
+            host = address
+    return host, int(port)
+
+
 def create_storage(config=None):
     """Build a storage instance from a config dict.
 
@@ -307,6 +331,13 @@ def create_storage(config=None):
     if db_type in ("pickled", "pickleddb"):
         path = config.get("path", "orion_tpu_db.pkl")
         return DocumentStorage(PickledDB(path, lock_timeout=config.get("lock_timeout", 60.0)))
+    if db_type in ("network", "netdb"):
+        from orion_tpu.storage.netdb import NetworkDB
+
+        host, port = _parse_network_address(config)
+        return DocumentStorage(
+            NetworkDB(host=host, port=port, timeout=config.get("timeout", 60.0))
+        )
     raise DatabaseError(f"Unknown storage type {db_type!r}")
 
 

@@ -1,0 +1,283 @@
+"""Networked document database: TCP server + client driver.
+
+Capability parity: reference `src/orion/core/io/database/mongodb.py` — the
+networked, multi-node storage backend.  The reference delegates to an
+external mongod; pymongo is not available in this image, so the framework
+ships its own wire protocol: newline-delimited JSON requests against a
+server-side :class:`~orion_tpu.storage.documents.MemoryDB`, whose per-op
+lock makes ``read_and_write`` (find-one-and-update) atomic across every
+connected worker — the same role mongod's atomic `find_one_and_update`
+plays in the reference (`mongodb.py:229-247`).
+
+Workers on different hosts coordinate through one server:
+
+    host A$ orion-tpu db serve --port 8765 --persist shared.pkl
+    host B$ ORION_DB_TYPE=network ORION_DB_ADDRESS=hostA:8765 orion-tpu hunt ...
+
+The server optionally persists every mutation to a pickle snapshot (atomic
+tempfile + rename, same pattern as the PickledDB backend) so it can restart
+without losing the experiment.
+"""
+
+import json
+import logging
+import os
+import pickle
+import socket
+import socketserver
+import tempfile
+import threading
+
+from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
+
+log = logging.getLogger(__name__)
+
+_TERM = b"\n"
+_MAX_LINE = 64 * 1024 * 1024
+
+# Ops a client may invoke — anything else is rejected (the wire protocol is
+# not a generic RPC surface).
+_DB_OPS = frozenset(
+    {
+        "write",
+        "read",
+        "read_and_write",
+        "count",
+        "remove",
+        "ensure_index",
+        "ensure_indexes",
+        "index_information",
+        "drop_index",
+        "ping",
+    }
+)
+
+
+class _JSONEncoder(json.JSONEncoder):
+    """Tolerate numpy scalars/arrays leaking into documents."""
+
+    def default(self, o):
+        for attr in ("item",):  # numpy scalar -> python scalar
+            if hasattr(o, attr) and not isinstance(o, (list, dict)):
+                try:
+                    return o.item()
+                except Exception:  # pragma: no cover - exotic objects
+                    break
+        if hasattr(o, "tolist"):
+            return o.tolist()
+        return super().default(o)
+
+
+def _dumps(obj):
+    return json.dumps(obj, cls=_JSONEncoder).encode() + _TERM
+
+
+def _read_line(sock_file):
+    line = sock_file.readline(_MAX_LINE)
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        db = self.server.db
+        while True:
+            try:
+                request = _read_line(self.rfile)
+            except (json.JSONDecodeError, OSError) as exc:
+                log.warning("bad request from %s: %s", self.client_address, exc)
+                return
+            if request is None:
+                return
+            self.wfile.write(_dumps(self._dispatch(db, request)))
+
+    def _dispatch(self, db, request):
+        op = request.get("op")
+        if op not in _DB_OPS:
+            return {"ok": False, "error": "DatabaseError", "message": f"bad op {op!r}"}
+        if op == "ping":
+            return {"ok": True, "result": "pong"}
+        try:
+            method = getattr(db, op)
+            result = method(*request.get("args", []), **request.get("kwargs", {}))
+            if op in ("write", "read_and_write", "remove", "ensure_index",
+                      "ensure_indexes", "drop_index"):
+                self.server.persist_snapshot()
+            return {"ok": True, "result": result}
+        except DuplicateKeyError as exc:
+            return {"ok": False, "error": "DuplicateKeyError", "message": str(exc)}
+        except KeyError as exc:
+            return {"ok": False, "error": "KeyError", "message": str(exc)}
+        except Exception as exc:  # pragma: no cover - defensive
+            log.exception("op %s failed", op)
+            return {"ok": False, "error": type(exc).__name__, "message": str(exc)}
+
+
+class DBServer(socketserver.ThreadingTCPServer):
+    """Serve a MemoryDB over TCP; one request = one locked DB operation."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host="127.0.0.1", port=0, persist=None):
+        self.persist = persist
+        self.db = MemoryDB()
+        self._persist_lock = threading.Lock()
+        if persist and os.path.exists(persist):
+            with open(persist, "rb") as handle:
+                self.db = pickle.load(handle)
+        super().__init__((host, port), _Handler)
+
+    @property
+    def address(self):
+        return self.server_address[:2]
+
+    def persist_snapshot(self):
+        if not self.persist:
+            return
+        with self._persist_lock:
+            directory = os.path.dirname(os.path.abspath(self.persist)) or "."
+            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as handle:
+                    # Hold the DB lock while pickling: handler threads mutate
+                    # the collections concurrently and pickle iterating a
+                    # changing dict raises mid-dump.
+                    with self.db._lock:
+                        pickle.dump(self.db, handle)
+                os.replace(tmp, self.persist)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+
+    def serve_background(self):
+        """Start serving on a daemon thread; returns (host, port)."""
+        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        thread.start()
+        return self.address
+
+
+def serve(host="127.0.0.1", port=8765, persist=None):  # pragma: no cover - CLI
+    """Blocking server entry point (`orion-tpu db serve`)."""
+    server = DBServer(host=host, port=port, persist=persist)
+    log.info("serving orion-tpu DB on %s:%s", *server.address)
+    print(f"orion-tpu db server listening on {server.address[0]}:{server.address[1]}")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+
+
+class NetworkDB:
+    """AbstractDB-contract client for a :class:`DBServer`.
+
+    Thread-safe: one socket guarded by a lock (requests are tiny; contention
+    is on the server's DB lock anyway).  Reconnects once on a dropped
+    connection so a restarted server (with ``--persist``) is transparent.
+    """
+
+    def __init__(self, host="127.0.0.1", port=8765, timeout=60.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = None
+        self._file = None
+
+    # --- wire ----------------------------------------------------------------
+    def _connect(self):
+        self._close()
+        sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._file = sock.makefile("rb")
+
+    def _close(self):
+        for closer in (self._file, self._sock):
+            if closer is not None:
+                try:
+                    closer.close()
+                except OSError:  # pragma: no cover
+                    pass
+        self._sock = self._file = None
+
+    def __getstate__(self):
+        # Sockets don't cross fork/pickle; children reconnect lazily.
+        return {"host": self.host, "port": self.port, "timeout": self.timeout}
+
+    def __setstate__(self, state):
+        self.__init__(**state)
+
+    # Ops safe to re-send after a dropped connection.  Mutating ops must NOT
+    # be retried blindly: the server may have applied the request before the
+    # reply was lost, and a re-send would double-apply it (a second trial
+    # reserved, a spurious DuplicateKeyError on an insert that succeeded).
+    _IDEMPOTENT = frozenset({"read", "count", "index_information", "ping"})
+
+    def _call(self, op, *args, **kwargs):
+        payload = _dumps({"op": op, "args": list(args), "kwargs": kwargs})
+        retriable = op in self._IDEMPOTENT
+        with self._lock:
+            for attempt in range(2):
+                sent = False
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    self._sock.sendall(payload)
+                    sent = True
+                    response = _read_line(self._file)
+                    if response is None:
+                        raise ConnectionError("server closed the connection")
+                    break
+                except (OSError, ConnectionError, json.JSONDecodeError) as exc:
+                    self._close()
+                    if attempt or (sent and not retriable):
+                        raise DatabaseError(
+                            f"connection to {self.host}:{self.port} lost during "
+                            f"{op!r}: {exc}"
+                        ) from exc
+        if response.get("ok"):
+            return response.get("result")
+        error = response.get("error")
+        message = response.get("message", "")
+        if error == "DuplicateKeyError":
+            raise DuplicateKeyError(message)
+        if error == "KeyError":
+            raise KeyError(message)
+        raise DatabaseError(f"{error}: {message}")
+
+    # --- AbstractDB contract --------------------------------------------------
+    def ping(self):
+        return self._call("ping") == "pong"
+
+    def ensure_index(self, collection, keys, unique=False):
+        return self._call("ensure_index", collection, keys, unique=unique)
+
+    def ensure_indexes(self, specs):
+        return self._call("ensure_indexes", [list(s) for s in specs])
+
+    def index_information(self, collection):
+        return self._call("index_information", collection)
+
+    def drop_index(self, collection, name):
+        return self._call("drop_index", collection, name)
+
+    def write(self, collection, data, query=None):
+        return self._call("write", collection, data, query=query)
+
+    def read(self, collection, query=None, projection=None):
+        return self._call("read", collection, query=query, projection=projection)
+
+    def read_and_write(self, collection, query, data):
+        return self._call("read_and_write", collection, query, data)
+
+    def count(self, collection, query=None):
+        return self._call("count", collection, query=query)
+
+    def remove(self, collection, query=None):
+        return self._call("remove", collection, query=query)

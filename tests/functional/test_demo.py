@@ -143,3 +143,50 @@ def test_broken_budget_on_final_iteration_reports_error(tmp_path):
          BROKEN_BOX, "-x~uniform(-50,50)"]
     )
     assert rc == 1
+
+
+def _run_network_worker(conf_path, name):
+    from orion_tpu.cli import main as _main
+
+    _main(
+        ["hunt", "-n", name, "-c", conf_path,
+         "--max-trials", "10", "--worker-trials", "10",
+         BLACK_BOX, "-x~uniform(-50,50)"]
+    )
+
+
+def test_two_workers_one_network_server(tmp_path):
+    """The multi-NODE story: two worker processes coordinate through one
+    `orion-tpu db serve` server over TCP (reference's MongoDB deployment,
+    docs/src/examples/cluster.rst — N hunts against one networked DB)."""
+    from orion_tpu.storage import DBServer
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    conf = tmp_path / "conf.yaml"
+    conf.write_text(
+        f"storage:\n  type: network\n  host: {host}\n  port: {port}\n"
+    )
+    try:
+        ctx = multiprocessing.get_context("spawn")
+        workers = [
+            ctx.Process(target=_run_network_worker, args=(str(conf), "netpair"))
+            for _ in range(2)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(timeout=240)
+            assert w.exitcode == 0
+        storage = create_storage({"type": "network", "host": host, "port": port})
+        exps = storage.fetch_experiments({"name": "netpair"})
+        assert len(exps) == 1
+        completed = [
+            t for t in storage.fetch_trials(uid=exps[0]["_id"])
+            if t.status == "completed"
+        ]
+        assert len(completed) >= 10
+        assert len({t.id for t in completed}) == len(completed)
+    finally:
+        server.shutdown()
+        server.server_close()

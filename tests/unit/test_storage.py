@@ -15,11 +15,21 @@ from orion_tpu.storage.base import DocumentStorage, ReadOnlyStorage
 from orion_tpu.utils.exceptions import DuplicateKeyError, FailedUpdate
 
 
-@pytest.fixture(params=["memory", "pickled"])
+@pytest.fixture(params=["memory", "pickled", "network"])
 def storage(request, tmp_path):
     if request.param == "memory":
-        return create_storage({"type": "memory"})
-    return create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+        yield create_storage({"type": "memory"})
+        return
+    if request.param == "pickled":
+        yield create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+        return
+    from orion_tpu.storage import DBServer
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    yield create_storage({"type": "network", "host": host, "port": port})
+    server.shutdown()
+    server.server_close()
 
 
 def new_trial(i=0, experiment="exp-id", **kw):
@@ -266,3 +276,123 @@ def test_projection_preserves_dotted_keys_and_id_only():
     assert out[0]["params"] == {"opt.lr": 1}
     only_id = db.read("c", projection={"_id": 1})
     assert only_id == [{"_id": "t"}]
+
+# --- network backend (reference MongoDB driver parity) ----------------------
+
+
+def _net_worker_reserve(host, port, out_queue):
+    storage = create_storage({"type": "network", "host": host, "port": port})
+    claimed = []
+    while True:
+        trial = storage.reserve_trial("exp-id")
+        if trial is None:
+            break
+        claimed.append(trial.id)
+    out_queue.put(claimed)
+
+
+def test_network_concurrent_reservation_across_processes():
+    """Multiple client processes against one server: every trial claimed
+    exactly once — the multi-node equivalent of the pickled flock test."""
+    from orion_tpu.storage import DBServer
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    try:
+        storage = create_storage({"type": "network", "host": host, "port": port})
+        all_ids = set()
+        for i in range(20):
+            t = new_trial(i)
+            storage.register_trial(t)
+            all_ids.add(t.id)
+
+        ctx = multiprocessing.get_context("spawn")
+        queue = ctx.Queue()
+        procs = [
+            ctx.Process(target=_net_worker_reserve, args=(host, port, queue))
+            for _ in range(4)
+        ]
+        for p in procs:
+            p.start()
+        results = [queue.get(timeout=60) for _ in procs]
+        for p in procs:
+            p.join(timeout=60)
+
+        flat = [tid for chunk in results for tid in chunk]
+        assert len(flat) == 20
+        assert set(flat) == all_ids
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_network_server_persistence_across_restarts(tmp_path):
+    """--persist lets the server restart without losing the experiment."""
+    from orion_tpu.storage import DBServer
+
+    snapshot = str(tmp_path / "snap.pkl")
+    server = DBServer(port=0, persist=snapshot)
+    host, port = server.serve_background()
+    storage = create_storage({"type": "network", "host": host, "port": port})
+    trial = new_trial(1)
+    storage.register_trial(trial)
+    server.shutdown()
+    server.server_close()
+
+    server2 = DBServer(port=0, persist=snapshot)
+    host2, port2 = server2.serve_background()
+    try:
+        storage2 = create_storage({"type": "network", "host": host2, "port": port2})
+        fetched = storage2.fetch_trials(uid="exp-id")
+        assert [t.id for t in fetched] == [trial.id]
+    finally:
+        server2.shutdown()
+        server2.server_close()
+
+
+def test_network_duplicate_key_crosses_the_wire():
+    from orion_tpu.storage import DBServer
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    try:
+        storage = create_storage({"type": "network", "host": host, "port": port})
+        trial = new_trial(3)
+        storage.register_trial(trial)
+        with pytest.raises(DuplicateKeyError):
+            storage.register_trial(new_trial(3))
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_network_client_reconnects_after_server_restart(tmp_path):
+    from orion_tpu.storage import DBServer, NetworkDB
+
+    snapshot = str(tmp_path / "snap.pkl")
+    server = DBServer(port=0, persist=snapshot)
+    host, port = server.serve_background()
+    db = NetworkDB(host=host, port=port)
+    db.write("c", {"_id": 1, "v": 1})
+    server.shutdown()
+    server.server_close()
+
+    # Restart on the SAME port so the same client handle keeps working.
+    server2 = DBServer(host=host, port=port, persist=snapshot)
+    server2.serve_background()
+    try:
+        assert db.read("c", {"_id": 1})[0]["v"] == 1
+    finally:
+        server2.shutdown()
+        server2.server_close()
+
+
+def test_network_address_forms():
+    from orion_tpu.storage.base import _parse_network_address
+    from orion_tpu.utils.exceptions import DatabaseError as DBErr
+
+    assert _parse_network_address({"address": "hostA:9000"}) == ("hostA", 9000)
+    assert _parse_network_address({"address": "hostA"}) == ("hostA", 8765)
+    assert _parse_network_address({"host": "h", "port": 1234}) == ("h", 1234)
+    with pytest.raises(DBErr):
+        _parse_network_address({"address": "hostA:"})

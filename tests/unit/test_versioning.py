@@ -75,3 +75,12 @@ def test_hash_config_file_tracks_content(tmp_path):
     h2 = hash_config_file(str(conf))
     assert h1 and h2 and h1 != h2
     assert hash_config_file(str(tmp_path / "missing.yaml")) is None
+
+
+def test_untracked_content_edit_changes_diff_sha(script_repo):
+    repo, script = script_repo
+    (repo / "helper.py").write_text("VALUE = 1\n")
+    first = infer_versioning_metadata(str(script))
+    (repo / "helper.py").write_text("VALUE = 2\n")  # same status listing
+    second = infer_versioning_metadata(str(script))
+    assert first["diff_sha"] != second["diff_sha"]
