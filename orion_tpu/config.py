@@ -60,10 +60,21 @@ DEFAULTS = {
 def _env_config():
     out = {}
     storage = {}
-    if os.getenv("ORION_DB_TYPE"):
-        storage["type"] = os.environ["ORION_DB_TYPE"]
-    if os.getenv("ORION_DB_ADDRESS"):
-        storage["path"] = os.environ["ORION_DB_ADDRESS"]
+    db_type = os.getenv("ORION_DB_TYPE")
+    if db_type:
+        storage["type"] = db_type
+    address = os.getenv("ORION_DB_ADDRESS")
+    if address:
+        if db_type in ("network", "netdb"):
+            # Parse host[:port] here so the normal merge precedence applies —
+            # a path-fallback downstream would lose to host/port keys merged
+            # in from the user config file.
+            host, _, port = address.partition(":")
+            storage["host"] = host
+            if port:
+                storage["port"] = int(port)
+        else:
+            storage["path"] = address
     if storage:
         out["storage"] = storage
     # Explicit coercions — the DEFAULTS values are None, so their type can't

@@ -20,6 +20,10 @@ import subprocess
 log = logging.getLogger(__name__)
 
 _GIT_TIMEOUT = 10.0
+#: Untracked files whose CONTENT feeds the code-identity hash (code only —
+#: data/log/checkpoint files change during a hunt without being code changes).
+_CODE_SUFFIXES = (".py", ".sh", ".yaml", ".yml", ".json", ".toml", ".cfg", ".ini")
+_MAX_HASHED_FILE = 1 << 20  # 1 MiB
 
 
 def _git(repo_dir, *argv):
@@ -58,15 +62,22 @@ def infer_versioning_metadata(script_path):
     status = _git(repo_dir, "status", "--porcelain")
     diff = _git(repo_dir, "diff", "HEAD") if head_sha else _git(repo_dir, "diff")
     # The working-tree hash covers the tracked diff, the status listing, AND
-    # the CONTENT of untracked files next to the script: `git diff HEAD` is
-    # blind to untracked files and the status listing only names them, but an
-    # edited untracked helper the script imports is still a code change.
-    # (Untracked files elsewhere in the repo appear in `status` by name only.)
+    # the CONTENT of untracked *code* files next to the script: `git diff
+    # HEAD` is blind to untracked files and the status listing only names
+    # them, but an edited untracked helper the script imports is still a
+    # code change.  Only small source files are content-hashed — untracked
+    # logs/checkpoints the script WRITES during a hunt must not churn the
+    # code identity and force a spurious branch on every resume.
     parts = [diff or "", status or ""]
     untracked = _git(repo_dir, "ls-files", "--others", "--exclude-standard")
     for rel in (untracked or "").splitlines():
+        if not rel.endswith(_CODE_SUFFIXES):
+            continue
+        path = os.path.join(repo_dir, rel)
         try:
-            with open(os.path.join(repo_dir, rel), "rb") as handle:
+            if os.path.getsize(path) > _MAX_HASHED_FILE:
+                continue
+            with open(path, "rb") as handle:
                 parts.append(rel + hashlib.sha256(handle.read()).hexdigest())
         except OSError:
             parts.append(rel)

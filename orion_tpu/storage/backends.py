@@ -26,6 +26,21 @@ class LockAcquisitionTimeout(DatabaseError):
     """Could not obtain the database file lock in time."""
 
 
+def atomic_pickle_dump(path, obj):
+    """Pickle ``obj`` to ``path`` atomically (tempfile in the target dir +
+    rename) — shared by the pickled backend and the network server snapshot."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".dbtmp-")
+    try:
+        with os.fdopen(fd, "wb") as handle:
+            pickle.dump(obj, handle)
+        os.replace(tmp, path)  # atomic on POSIX
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+
+
 @contextlib.contextmanager
 def _file_lock(lock_path, timeout=DEFAULT_LOCK_TIMEOUT, poll=0.01):
     fd = os.open(lock_path, os.O_CREAT | os.O_RDWR)
@@ -73,16 +88,7 @@ class PickledDB:
             return pickle.load(handle)
 
     def _dump(self, db):
-        dirname = os.path.dirname(self.path) or "."
-        fd, tmp = tempfile.mkstemp(dir=dirname, prefix=".dbtmp-")
-        try:
-            with os.fdopen(fd, "wb") as handle:
-                pickle.dump(db, handle)
-            os.replace(tmp, self.path)  # atomic on POSIX
-        except BaseException:
-            with contextlib.suppress(OSError):
-                os.remove(tmp)
-            raise
+        atomic_pickle_dump(self.path, db)
 
     @contextlib.contextmanager
     def _locked(self, write=True):

@@ -25,9 +25,10 @@ import os
 import pickle
 import socket
 import socketserver
-import tempfile
 import threading
+import time
 
+from orion_tpu.storage.backends import atomic_pickle_dump
 from orion_tpu.storage.documents import MemoryDB
 from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
 
@@ -121,37 +122,51 @@ class DBServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host="127.0.0.1", port=0, persist=None):
+    def __init__(self, host="127.0.0.1", port=0, persist=None, persist_interval=1.0):
         self.persist = persist
+        self.persist_interval = persist_interval
         self.db = MemoryDB()
         self._persist_lock = threading.Lock()
+        self._dirty = threading.Event()
+        self._stop_flusher = threading.Event()
+        self._flusher = None
         if persist and os.path.exists(persist):
             with open(persist, "rb") as handle:
                 self.db = pickle.load(handle)
         super().__init__((host, port), _Handler)
+        if persist:
+            self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
+            self._flusher.start()
 
     @property
     def address(self):
         return self.server_address[:2]
 
     def persist_snapshot(self):
-        if not self.persist:
+        """Mark the DB dirty; the flusher thread writes at most one snapshot
+        per ``persist_interval`` — a per-mutation dump would hold the DB lock
+        for an O(DB-size) pickle on every heartbeat at multi-worker scale."""
+        self._dirty.set()
+
+    def _flush_loop(self):
+        while not self._stop_flusher.wait(self.persist_interval):
+            self._flush_if_dirty()
+
+    def _flush_if_dirty(self):
+        if not (self.persist and self._dirty.is_set()):
             return
+        self._dirty.clear()
         with self._persist_lock:
-            directory = os.path.dirname(os.path.abspath(self.persist)) or "."
-            fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-            try:
-                with os.fdopen(fd, "wb") as handle:
-                    # Hold the DB lock while pickling: handler threads mutate
-                    # the collections concurrently and pickle iterating a
-                    # changing dict raises mid-dump.
-                    with self.db._lock:
-                        pickle.dump(self.db, handle)
-                os.replace(tmp, self.persist)
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
+            # Hold the DB lock while pickling: handler threads mutate the
+            # collections concurrently and pickle iterating a changing dict
+            # raises mid-dump.
+            with self.db._lock:
+                atomic_pickle_dump(self.persist, self.db)
+
+    def shutdown(self):
+        self._stop_flusher.set()
+        super().shutdown()
+        self._flush_if_dirty()  # final durable snapshot
 
     def serve_background(self):
         """Start serving on a daemon thread; returns (host, port)."""
@@ -177,17 +192,25 @@ class NetworkDB:
     """AbstractDB-contract client for a :class:`DBServer`.
 
     Thread-safe: one socket guarded by a lock (requests are tiny; contention
-    is on the server's DB lock anyway).  Reconnects once on a dropped
-    connection so a restarted server (with ``--persist``) is transparent.
+    is on the server's DB lock anyway).  Idempotent reads reconnect and
+    retry transparently across a server restart (``--persist``).  Mutations
+    are never blindly re-sent; instead, a connection idle longer than
+    ``idle_probe`` seconds is ping-probed (and re-established if dead)
+    before a mutation uses it, so the common restart-while-idle case also
+    succeeds.  Only a server death in the middle of an in-flight mutation
+    surfaces as DatabaseError — the one case where applied-or-not is
+    genuinely unknowable without server-side request ids.
     """
 
-    def __init__(self, host="127.0.0.1", port=8765, timeout=60.0):
+    def __init__(self, host="127.0.0.1", port=8765, timeout=60.0, idle_probe=1.0):
         self.host = host
         self.port = int(port)
         self.timeout = timeout
+        self.idle_probe = idle_probe
         self._lock = threading.Lock()
         self._sock = None
         self._file = None
+        self._last_used = 0.0
 
     # --- wire ----------------------------------------------------------------
     def _connect(self):
@@ -196,6 +219,7 @@ class NetworkDB:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
         self._file = sock.makefile("rb")
+        self._last_used = time.monotonic()
 
     def _close(self):
         for closer in (self._file, self._sock):
@@ -219,6 +243,27 @@ class NetworkDB:
     # reserved, a spurious DuplicateKeyError on an insert that succeeded).
     _IDEMPOTENT = frozenset({"read", "count", "index_information", "ping"})
 
+    def _exchange(self, payload):
+        """One request/response on the current socket; raises on any break."""
+        self._sock.sendall(payload)
+        response = _read_line(self._file)
+        if response is None:
+            raise ConnectionError("server closed the connection")
+        self._last_used = time.monotonic()
+        return response
+
+    def _probe_idle_connection(self):
+        """Ping a connection that has sat idle so a mutation never rides a
+        half-open socket from a restarted server."""
+        if self._sock is None:
+            return
+        if time.monotonic() - self._last_used <= self.idle_probe:
+            return
+        try:
+            self._exchange(_dumps({"op": "ping"}))
+        except (OSError, ConnectionError, json.JSONDecodeError):
+            self._close()  # mutation path will reconnect fresh
+
     def _call(self, op, *args, **kwargs):
         payload = _dumps({"op": op, "args": list(args), "kwargs": kwargs})
         retriable = op in self._IDEMPOTENT
@@ -226,15 +271,14 @@ class NetworkDB:
             for attempt in range(2):
                 sent = False
                 try:
+                    if not retriable:
+                        self._probe_idle_connection()
                     if self._sock is None:
                         self._connect()
-                    self._sock.sendall(payload)
-                    sent = True
-                    response = _read_line(self._file)
-                    if response is None:
-                        raise ConnectionError("server closed the connection")
+                    response = self._exchange(payload)
                     break
                 except (OSError, ConnectionError, json.JSONDecodeError) as exc:
+                    sent = self._sock is not None
                     self._close()
                     if attempt or (sent and not retriable):
                         raise DatabaseError(

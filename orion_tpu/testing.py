@@ -1,0 +1,175 @@
+"""Shipped test harness for algorithm/plugin authors.
+
+Capability parity: reference `src/orion/core/utils/tests.py:59-212`
+(``OrionState``) and the scriptable ``DumbAlgo`` fake from the reference's
+`tests/conftest.py:23-117` — shipped *in the package* so a third-party
+algorithm plugin can test suggest/observe against the full producer/worker
+stack using only the published distribution:
+
+    from orion_tpu.testing import DumbAlgo, OrionState
+
+    def test_my_plugin():
+        with OrionState(
+            experiments=[{"name": "exp", "priors": {"/x": "uniform(0, 1)"}}],
+        ) as state:
+            exp = state.get_experiment("exp").instantiate()
+            producer = Producer(exp)
+            producer.update(); producer.produce(4)
+            ...
+
+``OrionState`` installs a fresh storage (in-memory by default, or a
+file-locked pickled DB in a tempdir with ``pickled=True`` for multi-process
+scenarios), preloads experiments/trials/lies, swaps the process-wide storage
+singleton, and restores everything on exit.
+"""
+
+import contextlib
+import os
+import shutil
+import tempfile
+
+import numpy as np
+
+import orion_tpu.storage.base as _storage_base
+from orion_tpu.algo.base import BaseAlgorithm, algo_registry
+from orion_tpu.core.experiment import Experiment, build_experiment
+from orion_tpu.core.trial import Trial
+from orion_tpu.storage import create_storage
+
+
+@algo_registry.register("dumbalgo")
+class DumbAlgo(BaseAlgorithm):
+    """Fully scriptable fake algorithm (reference `tests/conftest.py:23-117`).
+
+    - suggests a constant unit-cube ``value`` (so resulting params are
+      deterministic), counting every request;
+    - records every observation it receives;
+    - ``opt_out=True`` makes ``suggest`` return None (the temporary opt-out
+      contract, reference `algo/base.py:142-163`);
+    - ``done=True`` drives the ``is_done`` early-stop path.
+    """
+
+    def __init__(self, space, value=0.5, possible_values=None, seed=None):
+        super().__init__(space, seed=seed, value=value)
+        self.value = value
+        # Scriptable distinct suggestions (reference DumbAlgo's
+        # possible_values): successive suggested points consume successive
+        # values, so a producer asking for q unique trials gets them.
+        self.possible_values = list(possible_values or [])
+        self._value_cursor = 0
+        self.n_suggested = 0
+        self.observed_params = []
+        self.observed_results = []
+        self.opt_out = False
+        self.done = False
+
+    def _suggest_cube(self, num):
+        if self.opt_out:
+            return None
+        self.n_suggested += num
+        if self.possible_values:
+            rows = []
+            for _ in range(num):
+                v = self.possible_values[
+                    self._value_cursor % len(self.possible_values)
+                ]
+                self._value_cursor += 1
+                rows.append(np.full((self.space.n_cols,), v))
+            return np.stack(rows)
+        return np.full((num, self.space.n_cols), self.value)
+
+    def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
+        self.observed_params.extend(params_list or [])
+        self.observed_results.extend(np.asarray(objectives).tolist())
+
+    def register_suggestion(self, params):
+        # The producer suggests through a fresh deepcopy every round; advance
+        # the REAL instance's cursor per durably-registered trial so the next
+        # naive copy starts at the first unconsumed value.
+        if self.possible_values:
+            self._value_cursor += 1
+
+    @property
+    def is_done(self):
+        return self.done
+
+
+class OrionState(contextlib.AbstractContextManager):
+    """Temporary, fully-populated orion-tpu stack for tests.
+
+    Parameters
+    ----------
+    experiments : list of dict
+        Experiment configs for :func:`build_experiment` (each needs at least
+        ``name``; ``priors`` defaults to a 1-D uniform space and
+        ``algorithms`` to the scriptable ``dumbalgo``).
+    trials / lies : list of dict or Trial
+        Preloaded into the FIRST experiment unless a dict carries an
+        explicit ``experiment`` id.
+    pickled : bool
+        Use a file-locked pickled DB in a private tempdir instead of the
+        in-memory store — reach for this in multi-process tests.
+    """
+
+    def __init__(self, experiments=(), trials=(), lies=(), pickled=False):
+        self._experiment_configs = list(experiments)
+        self._trial_docs = list(trials)
+        self._lie_docs = list(lies)
+        self._pickled = pickled
+        self._tempdir = None
+        self._saved_singleton = None
+        self.storage = None
+        self.experiments = []
+
+    # --- setup / teardown ---------------------------------------------------
+    def __enter__(self):
+        if self._pickled:
+            self._tempdir = tempfile.mkdtemp(prefix="orion_tpu_state_")
+            self.storage = create_storage(
+                {"type": "pickled", "path": os.path.join(self._tempdir, "db.pkl")}
+            )
+        else:
+            self.storage = create_storage({"type": "memory"})
+        self._saved_singleton = _storage_base._storage_singleton
+        _storage_base._storage_singleton = self.storage
+
+        for config in self._experiment_configs:
+            config = dict(config)
+            name = config.pop("name")
+            config.setdefault("priors", {"/x": "uniform(0, 1)"})
+            config.setdefault("algorithms", {"dumbalgo": {}})
+            self.experiments.append(
+                build_experiment(self.storage, name, **config)
+            )
+        default_exp = self.experiments[0].id if self.experiments else None
+        for doc in self._trial_docs:
+            self.storage.register_trial(self._as_trial(doc, default_exp))
+        for doc in self._lie_docs:
+            self.storage.register_lie(self._as_trial(doc, default_exp))
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _storage_base._storage_singleton = self._saved_singleton
+        if self._tempdir:
+            shutil.rmtree(self._tempdir, ignore_errors=True)
+        return False
+
+    # --- helpers ------------------------------------------------------------
+    def _as_trial(self, doc, default_experiment):
+        if isinstance(doc, Trial):
+            if doc.experiment is None and default_experiment is not None:
+                doc.experiment = default_experiment
+            return doc
+        doc = dict(doc)
+        doc.setdefault("experiment", default_experiment)
+        return Trial(**doc)
+
+    def get_experiment(self, name, version=None):
+        """Reload an experiment from the temporary storage."""
+        query = {"name": name}
+        if version is not None:
+            query["version"] = version
+        docs = self.storage.fetch_experiments(query)
+        if not docs:
+            raise KeyError(f"no experiment {name!r} in OrionState")
+        return Experiment(self.storage, max(docs, key=lambda d: d.get("version", 1)))

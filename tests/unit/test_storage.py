@@ -396,3 +396,56 @@ def test_network_address_forms():
     assert _parse_network_address({"host": "h", "port": 1234}) == ("h", 1234)
     with pytest.raises(DBErr):
         _parse_network_address({"address": "hostA:"})
+
+
+def test_network_mutation_succeeds_after_idle_restart(tmp_path):
+    """A mutation on a connection that idled across a server restart must be
+    probed-and-reconnected, not failed (the restart-while-idle case)."""
+    from orion_tpu.storage import DBServer, NetworkDB
+
+    snapshot = str(tmp_path / "snap.pkl")
+    server = DBServer(port=0, persist=snapshot)
+    host, port = server.serve_background()
+    db = NetworkDB(host=host, port=port, idle_probe=0.05)
+    db.write("c", {"_id": 1, "v": 1})
+    server.shutdown()
+    server.server_close()
+
+    server2 = DBServer(host=host, port=port, persist=snapshot)
+    server2.serve_background()
+    try:
+        time.sleep(0.1)  # idle past the probe threshold
+        db.write("c", {"_id": 2, "v": 2})  # mutation, not a read
+        assert db.count("c") == 2
+    finally:
+        server2.shutdown()
+        server2.server_close()
+
+
+def test_network_server_flushes_snapshot_on_shutdown(tmp_path):
+    import pickle
+
+    from orion_tpu.storage import DBServer, NetworkDB
+
+    snapshot = str(tmp_path / "snap.pkl")
+    server = DBServer(port=0, persist=snapshot, persist_interval=60.0)
+    host, port = server.serve_background()
+    NetworkDB(host=host, port=port).write("c", {"_id": 1})
+    # Interval is 60s so only the shutdown flush can have written it.
+    server.shutdown()
+    server.server_close()
+    with open(snapshot, "rb") as fh:
+        assert pickle.load(fh).count("c") == 1
+
+
+def test_env_address_overrides_config_host(monkeypatch):
+    from orion_tpu.config import _env_config, merge_configs
+
+    monkeypatch.setenv("ORION_DB_TYPE", "network")
+    monkeypatch.setenv("ORION_DB_ADDRESS", "hostA:9100")
+    merged = merge_configs(
+        {"storage": {"type": "network", "host": "127.0.0.1", "port": 8765}},
+        _env_config(),
+    )
+    assert merged["storage"]["host"] == "hostA"
+    assert merged["storage"]["port"] == 9100

@@ -84,3 +84,14 @@ def test_untracked_content_edit_changes_diff_sha(script_repo):
     (repo / "helper.py").write_text("VALUE = 2\n")  # same status listing
     second = infer_versioning_metadata(str(script))
     assert first["diff_sha"] != second["diff_sha"]
+
+
+def test_untracked_log_files_do_not_churn_identity(script_repo):
+    """Untracked non-code output (logs/checkpoints the script writes) must
+    not change the code identity — it would force a branch every resume."""
+    repo, script = script_repo
+    (repo / "train.log").write_text("step 1\n")
+    first = infer_versioning_metadata(str(script))
+    (repo / "train.log").write_text("step 1\nstep 2\n")  # grows during hunt
+    second = infer_versioning_metadata(str(script))
+    assert first["diff_sha"] == second["diff_sha"]

@@ -9,7 +9,6 @@ tests/conftest.py:23-117.
 import numpy as np
 import pytest
 
-from orion_tpu.algo.base import BaseAlgorithm, algo_registry
 from orion_tpu.core.experiment import build_experiment
 from orion_tpu.core.producer import Producer
 from orion_tpu.core.strategy import create_strategy
@@ -19,27 +18,9 @@ from orion_tpu.storage import create_storage
 from orion_tpu.utils.exceptions import SampleTimeout
 
 
-@algo_registry.register("dumbalgo")
-class DumbAlgo(BaseAlgorithm):
-    """Scriptable fake: returns a fixed value, counts calls, records observes."""
-
-    def __init__(self, space, value=0.5, seed=None):
-        super().__init__(space, seed=seed, value=value)
-        self.value = value
-        self.n_suggested = 0
-        self.observed_params = []
-        self.observed_results = []
-        self.opt_out = False
-
-    def _suggest_cube(self, num):
-        if self.opt_out:
-            return None
-        self.n_suggested += num
-        return np.full((num, self.space.n_cols), self.value)
-
-    def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
-        self.observed_params.extend(params_list)
-        self.observed_results.extend(objectives.tolist())
+# The scriptable fake ships in the package so plugin authors get the same
+# harness (reference utils/tests.py); importing registers it.
+from orion_tpu.testing import DumbAlgo  # noqa: E402  (registers "dumbalgo")
 
 
 @pytest.fixture
