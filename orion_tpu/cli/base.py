@@ -23,6 +23,13 @@ def add_experiment_args(parser, with_user_args=True):
     group.add_argument("-n", "--name", help="experiment name")
     group.add_argument("--exp-version", type=int, default=None, help="experiment version")
     group.add_argument(
+        "-u",
+        "--user",
+        default=None,
+        help="user namespace (defaults to the system user; experiments are "
+        "stored under metadata.user and -u filters lookups to that user)",
+    )
+    group.add_argument(
         "-c", "--config", metavar="path", help="orion-tpu configuration file (yaml)"
     )
     group.add_argument(
@@ -59,6 +66,7 @@ def load_cli_config(args):
         for key, value in {
             "name": getattr(args, "name", None),
             "version": getattr(args, "exp_version", None),
+            "user": getattr(args, "user", None),
             "max_trials": getattr(args, "max_trials", None),
             "pool_size": getattr(args, "pool_size", None),
             "working_dir": getattr(args, "working_dir", None),
@@ -76,11 +84,22 @@ def load_cli_config(args):
     return resolve_config(file_config, cmd_config, storage_override)
 
 
-def build_from_args(args, need_user_args=True, allow_create=True):
+def _default_user():
+    import getpass
+
+    try:
+        return getpass.getuser()
+    except Exception:  # pragma: no cover - no passwd entry
+        return os.environ.get("USER", "unknown")
+
+
+def build_from_args(args, need_user_args=True, allow_create=True, view=False):
     """CLI args -> (experiment, cmdline_parser), with storage wired up.
 
-    ``allow_create=False`` (read-only commands: info, status, insert) only
+    ``allow_create=False`` (lookup commands: info, status, insert) only
     loads existing experiments — a typo'd name must never persist a ghost.
+    ``view=True`` additionally wraps the result in a read-only
+    :class:`ExperimentView` (info/status paths).
     """
     config = load_cli_config(args)
     if not config.get("name"):
@@ -98,6 +117,10 @@ def build_from_args(args, need_user_args=True, allow_create=True):
         query = {"name": config["name"]}
         if config.get("version") is not None:
             query["version"] = config["version"]
+        if config.get("user"):
+            # -u/--user namespacing (reference `cli/base.py:94`): an
+            # explicit user restricts the lookup to that user's experiments.
+            query["metadata.user"] = config["user"]
         existing = storage.fetch_experiments(query)
         if not existing:
             if not allow_create:
@@ -109,16 +132,30 @@ def build_from_args(args, need_user_args=True, allow_create=True):
             )
 
     if not allow_create:
-        # Read-only commands (info/status/insert) must never branch: their
+        # Lookup commands (info/status/insert) must never branch: their
         # user_args are not a command line (insert passes `x=1.2`
         # assignments) and a lookup must not mutate the experiment tree —
         # so pass NO config at all, only the identity.
+        latest = max(existing, key=lambda d: d.get("version", 1))
         experiment = build_experiment(
-            storage, config["name"], version=config.get("version")
+            storage,
+            config["name"],
+            version=latest.get("version"),
+            user=config.get("user"),
         )
+        if view:
+            from orion_tpu.core.experiment import ExperimentView
+
+            experiment = ExperimentView(experiment)
         return experiment, parser
 
-    metadata = {"user_args": user_args, "parser_state": parser.state_dict()}
+    metadata = {
+        "user_args": user_args,
+        "parser_state": parser.state_dict(),
+        # Experiments are namespaced per user (reference stores
+        # metadata.user on every experiment, `resolve_config.py`).
+        "user": config.get("user") or _default_user(),
+    }
     script_path = None
     config_file_path = parser.config_file_path
     if user_args:
@@ -149,6 +186,7 @@ def build_from_args(args, need_user_args=True, allow_create=True):
         storage,
         config["name"],
         version=config.get("version"),
+        user=config.get("user"),
         priors=priors or None,
         metadata=metadata,
         max_trials=config.get("max_trials"),

@@ -37,6 +37,13 @@ def add_subparser(subparsers):
         default=None,
         help="seconds the producer may go without registering a new point",
     )
+    group.add_argument(
+        "--profile",
+        metavar="DIR",
+        default=None,
+        help="write a jax.profiler trace of the whole hunt to DIR "
+        "(inspect with TensorBoard / xprof)",
+    )
     parser.set_defaults(func=main)
     return parser
 
@@ -44,6 +51,11 @@ def add_subparser(subparsers):
 def main(args):
     experiment, parser = build_from_args(args)
     experiment.instantiate()
+    profile_dir = getattr(args, "profile", None)
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
     try:
         workon(
             experiment,
@@ -57,5 +69,11 @@ def main(args):
     except BrokenExperiment as exc:
         print(f"Error: {exc}", file=sys.stderr)
         return 1
+    finally:
+        if profile_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+            print(f"jax profiler trace written to {profile_dir}", file=sys.stderr)
     print(format_stats(experiment))
     return 0

@@ -63,10 +63,45 @@ def format_info(experiment):
         out.append(f"start time: {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(stats['start_time']))}")
     if stats.get("duration") is not None:
         out.append(f"duration: {stats['duration']:.1f}s")
+
+    perf = _perf_section(experiment)
+    if perf:
+        out.append(_section("Performance"))
+        out.extend(perf)
     return "\n".join(out) + "\n"
 
 
+def _perf_section(experiment):
+    """suggest/observe latency percentiles from producer telemetry
+    (SURVEY §5: timing hooks are a TPU-build addition; no reference
+    counterpart)."""
+    lines = []
+    for op in ("suggest", "observe"):
+        try:
+            docs = experiment.storage.fetch_timings(experiment, op=op)
+        except Exception:
+            return []
+        if not docs:
+            continue
+        durations = sorted(d["duration"] for d in docs)
+        n_points = sum(d.get("count", 1) for d in docs)
+
+        def pct(p):
+            # Nearest-rank percentile: ceil(p/100 * n) - 1 (0-indexed).
+            idx = max(0, -(-int(p * len(durations)) // 100) - 1)
+            return durations[min(idx, len(durations) - 1)]
+
+        lines.append(
+            f"{op}: {len(durations)} rounds, {n_points} points | "
+            f"p50 {pct(50) * 1e3:.1f}ms  p90 {pct(90) * 1e3:.1f}ms  "
+            f"p99 {pct(99) * 1e3:.1f}ms  max {durations[-1] * 1e3:.1f}ms"
+        )
+    return lines
+
+
 def main(args):
-    experiment, _parser = build_from_args(args, need_user_args=False, allow_create=False)
+    experiment, _parser = build_from_args(
+        args, need_user_args=False, allow_create=False, view=True
+    )
     print(format_info(experiment))
     return 0

@@ -19,6 +19,11 @@ def add_subparser(subparsers):
         "-C", "--collapse", action="store_true",
         help="aggregate each EVC tree into its root experiment",
     )
+    parser.add_argument(
+        "-e", "--expand-versions", action="store_true",
+        help="one section per experiment version instead of aggregating a "
+        "name's versions together (reference `cli/status.py:41`)",
+    )
     parser.set_defaults(func=main)
     return parser
 
@@ -52,6 +57,8 @@ def main(args):
     query = {}
     if config.get("name"):
         query["name"] = config["name"]
+    if config.get("user"):
+        query["metadata.user"] = config["user"]
     experiments = sorted(
         storage.fetch_experiments(query),
         key=lambda e: (e["name"], e.get("version", 1)),
@@ -85,12 +92,25 @@ def main(args):
     for exp in experiments:
         by_name.setdefault(exp["name"], []).append(exp)
 
+    expand = getattr(args, "expand_versions", False)
     for name, versions in sorted(by_name.items()):
-        for exp in versions:
-            title = f"{name}-v{exp.get('version', 1)}"
-            print(title)
-            print("=" * len(title))
-            trials = storage.fetch_trials(uid=exp["_id"])
+        if expand:
+            # One section per version (reference --expand-versions).
+            for exp in versions:
+                title = f"{name}-v{exp.get('version', 1)}"
+                print(title)
+                print("=" * len(title))
+                trials = storage.fetch_trials(uid=exp["_id"])
+                body = _trial_lines(trials) if args.all else _status_table(trials)
+                print("\n".join(body) + "\n")
+        else:
+            # Default: a name's versions aggregate into one section
+            # (reference shows only the latest/aggregated unless expanded).
+            print(name)
+            print("=" * len(name))
+            trials = []
+            for exp in versions:
+                trials.extend(storage.fetch_trials(uid=exp["_id"]))
             body = _trial_lines(trials) if args.all else _status_table(trials)
             print("\n".join(body) + "\n")
     return 0

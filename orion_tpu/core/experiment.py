@@ -188,10 +188,56 @@ class Experiment:
         return out
 
 
+class ExperimentView:
+    """Non-writable experiment façade (reference `experiment.py:673-744`).
+
+    Wraps a built :class:`Experiment`, whitelists read-only attributes, and
+    swaps its storage handle for a :class:`ReadOnlyStorage` so even the
+    allowed methods cannot mutate anything.  Used by the info/status/list
+    CLI paths.
+    """
+
+    __slots__ = ("_experiment",)
+
+    valid_attributes = frozenset(
+        # attributes
+        ["name", "version", "metadata", "refers", "max_trials", "max_broken",
+         "pool_size", "working_dir", "algo_config", "strategy_config",
+         "priors", "heartbeat", "max_idle_time"]
+        # properties
+        + ["id", "space", "is_done", "is_broken", "stats", "storage"]
+        # methods
+        + ["configuration", "fetch_trials", "fetch_trials_by_status",
+           "get_trial"]
+    )
+
+    def __init__(self, experiment):
+        from orion_tpu.storage.base import ReadOnlyStorage
+
+        experiment._storage = ReadOnlyStorage(experiment.storage)
+        object.__setattr__(self, "_experiment", experiment)
+
+    def __getattr__(self, name):
+        if name not in self.valid_attributes:
+            raise AttributeError(
+                f"Cannot access attribute {name!r} on view-only experiments."
+            )
+        return getattr(self._experiment, name)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("ExperimentView is read-only")
+
+    def __repr__(self):
+        return (
+            f"ExperimentView(name={self.name}, version={self.version})"
+        )
+
+
 def build_experiment(
     storage,
     name,
     version=None,
+    user=None,
     priors=None,
     branch_config=None,
     **config,
@@ -206,7 +252,7 @@ def build_experiment(
     """
     config = {k: v for k, v in config.items() if v is not None}
     for attempt in range(2):
-        existing = _fetch_config(storage, name, version)
+        existing = _fetch_config(storage, name, version, user=user)
         if existing is None:
             # Non-mutating read of metadata: on a lost creation race the SAME
             # config dict feeds the resume path below, where popped metadata
@@ -223,7 +269,9 @@ def build_experiment(
             }
             full.setdefault("algorithms", "random")
             full.setdefault("strategy", "MaxParallelStrategy")
-            full["_id"] = full.get("_id") or Trial.compute_id(name, {"v": full["version"]})
+            full["_id"] = full.get("_id") or experiment_id(
+                name, full["version"], full["metadata"].get("user")
+            )
             try:
                 created = storage.create_experiment(full)
                 return Experiment(storage, created)
@@ -264,10 +312,28 @@ def build_experiment(
     raise RaceCondition(f"could not build experiment {name!r}")
 
 
-def _fetch_config(storage, name, version=None):
+def experiment_id(name, version, user=None):
+    """Deterministic experiment identity.
+
+    The user is part of the key: two users may own same-named experiments
+    (per-user namespacing), and a name+version-only id would collide on the
+    unique index at creation.  ``user=None`` keeps the historical formula so
+    pre-existing databases resume unchanged.
+    """
+    key = {"v": version}
+    if user:
+        key["u"] = user
+    return Trial.compute_id(name, key)
+
+
+def _fetch_config(storage, name, version=None, user=None):
     query = {"name": name}
     if version is not None:
         query["version"] = version
+    if user is not None:
+        # -u/--user namespacing: an explicit user only sees (and resumes)
+        # their own experiments; same name under another user is free.
+        query["metadata.user"] = user
     docs = storage.fetch_experiments(query)
     if not docs:
         return None

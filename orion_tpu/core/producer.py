@@ -35,6 +35,7 @@ class Producer:
         self._observed_ids = set()  # replaces reference TrialsHistory dedup
         self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
         self.failure_count = 0
+        self._pending_timings = []
         # Probe the EVC family ONCE: walking the tree costs extra collection
         # scans per round (each a full lock/unpickle on the file backend),
         # which an un-branched experiment should never pay.  A branch
@@ -67,17 +68,35 @@ class Producer:
         incomplete = [t for t in trials if not t.is_stopped]
         self._update_algorithm(completed)
         self._update_naive_algorithm(incomplete)
+        self._flush_timings()
 
     def _update_algorithm(self, completed):
         fresh = [t for t in completed if t.id not in self._observed_ids]
         if fresh:
             params = [t.params for t in fresh]
             results = [_trial_results(t) for t in fresh]
+            t0 = time.perf_counter()
             self.algorithm.observe(params, results)
+            self._record_timing("observe", time.perf_counter() - t0, len(fresh))
             self.strategy.observe(params, results)
             for t in fresh:
                 self._observed_ids.add(t.id)
             self._leaf_ids = [t.id for t in fresh]
+
+    def _record_timing(self, op, duration, count):
+        """Buffer a timing sample; flushed once per produce()/update() round
+        so telemetry never adds a storage write inside the hot retry loop."""
+        self._pending_timings.append((op, duration, count))
+
+    def _flush_timings(self):
+        """Telemetry must never break the run (SURVEY §5 timing hooks)."""
+        if not self._pending_timings:
+            return
+        samples, self._pending_timings = self._pending_timings, []
+        try:
+            self.experiment.storage.record_timings(self.experiment, samples)
+        except Exception:  # pragma: no cover - read-only/remote storage quirks
+            log.debug("could not record timings", exc_info=True)
 
     def _update_naive_algorithm(self, incomplete):
         """Naive algo = deepcopy of real + lies for in-flight trials
@@ -118,7 +137,12 @@ class Producer:
                 raise SampleTimeout(
                     f"algorithm produced no new unique point in {self.max_idle_time}s"
                 )
+            t0 = time.perf_counter()
             suggested = self.naive_algorithm.suggest(pool_size - registered)
+            if suggested is not None:
+                self._record_timing(
+                    "suggest", time.perf_counter() - t0, len(suggested)
+                )
             if suggested is None:
                 log.debug("algorithm opted out of suggesting; backing off")
                 self.backoff()
@@ -141,6 +165,7 @@ class Producer:
                     self.algorithm.register_suggestion(params)
                     log.debug("duplicate suggestion %s; backing off", trial.id)
                     self.backoff()
+        self._flush_timings()
         return registered
 
     def backoff(self):

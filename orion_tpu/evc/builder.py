@@ -192,7 +192,10 @@ def branch_experiment(storage, parent, new_priors, branch_config=None, **config)
             "adapter": adapter.to_dict(),
         },
     }
-    child_config["_id"] = Trial.compute_id(child_name, {"v": child_version})
+    from orion_tpu.core.experiment import experiment_id
+
+    child_user = child_config["metadata"].get("user")
+    child_config["_id"] = experiment_id(child_name, child_version, child_user)
     for attempt in range(2):
         try:
             created = storage.create_experiment(child_config)
@@ -205,7 +208,7 @@ def branch_experiment(storage, parent, new_priors, branch_config=None, **config)
             # Concurrent branch to the same (name, version): bump and retry.
             child_version += 1
             child_config["version"] = child_version
-            child_config["_id"] = Trial.compute_id(child_name, {"v": child_version})
+            child_config["_id"] = experiment_id(child_name, child_version, child_user)
     raise RaceCondition(
         f"lost branching race for experiment {child_name!r} twice"
     )

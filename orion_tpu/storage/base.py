@@ -89,9 +89,17 @@ class DocumentStorage(BaseStorage):
 
     def _setup_indexes(self):
         # Reference `legacy.py:70-88`; batched into one backend write cycle.
+        try:
+            # Schema migration: the pre-user index would keep enforcing
+            # name+version uniqueness across users on older databases.
+            self._db.drop_index("experiments", "name_version_1")
+        except (KeyError, DatabaseError):
+            pass
         self._db.ensure_indexes(
             [
-                ("experiments", ["name", "version"], True),
+                # The user is part of experiment identity (per-user
+                # namespacing): two users may own same-named experiments.
+                ("experiments", ["name", "version", "metadata.user"], True),
                 ("trials", ["experiment"], False),
                 ("trials", ["status"], False),
                 ("trials", ["experiment", "status"], False),
@@ -254,6 +262,53 @@ class DocumentStorage(BaseStorage):
             "trials", {"experiment": _exp_id(experiment), "status": "broken"}
         )
 
+    # --- telemetry (SURVEY §5: suggest/observe timing, TPU-build addition) ---
+    #: Oldest samples are pruned past this per-experiment count so the
+    #: telemetry collection cannot grow without bound on long hunts.
+    TELEMETRY_CAP = 5000
+
+    def record_timing(self, experiment, op, duration, count=1):
+        """One timing sample: op in {'suggest', 'observe'}."""
+        self.record_timings(experiment, [(op, duration, count)])
+
+    def record_timings(self, experiment, samples):
+        """Batched samples [(op, duration, count), ...] in ONE backend write
+        (a write per sample would cost a full lock/rewrite cycle each on the
+        file backend — on the producer's hot path)."""
+        if not samples:
+            return
+        now = time.time()
+        exp_id = _exp_id(experiment)
+        self._db.write(
+            "telemetry",
+            [
+                {
+                    "experiment": exp_id,
+                    "op": op,
+                    "duration": float(duration),
+                    "count": int(count),
+                    "time": now,
+                }
+                for op, duration, count in samples
+            ],
+        )
+        n = self._db.count("telemetry", {"experiment": exp_id})
+        if n > self.TELEMETRY_CAP:
+            docs = self.fetch_timings(experiment)  # time-sorted ascending
+            cutoff = docs[n - self.TELEMETRY_CAP]["time"]
+            self._db.remove(
+                "telemetry",
+                {"experiment": exp_id, "time": {"$lt": cutoff}},
+            )
+
+    def fetch_timings(self, experiment, op=None):
+        query = {"experiment": _exp_id(experiment)}
+        if op is not None:
+            query["op"] = op
+        docs = self._db.read("telemetry", query)
+        docs.sort(key=lambda d: d.get("time") or 0.0)
+        return docs
+
     def fetch_noncompleted_trials(self, experiment):
         docs = self._db.read(
             "trials",
@@ -280,6 +335,7 @@ _READONLY_METHODS = {
     "get_trial",
     "count_completed_trials",
     "count_broken_trials",
+    "fetch_timings",
 }
 
 

@@ -449,3 +449,15 @@ def test_env_address_overrides_config_host(monkeypatch):
     )
     assert merged["storage"]["host"] == "hostA"
     assert merged["storage"]["port"] == 9100
+
+
+def test_telemetry_batched_write_and_cap(storage):
+    storage.TELEMETRY_CAP = 50
+    for batch in range(6):
+        storage.record_timings(
+            "exp-id", [("suggest", 0.01 * batch + i * 1e-4, 1) for i in range(10)]
+        )
+    docs = storage.fetch_timings("exp-id")
+    assert len(docs) <= 50
+    # The newest samples survive the prune.
+    assert docs[-1]["duration"] >= 0.05

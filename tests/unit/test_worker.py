@@ -281,3 +281,18 @@ def test_convert_yaml_preserves_literal_dotted_keys(tmp_path):
     import yaml
 
     assert yaml.safe_load(out.read_text()) == {"opt.lr": 0.5, "plain": 5}
+
+
+def test_producer_records_suggest_and_observe_timings(experiment):
+    producer = Producer(experiment)
+    producer.update()
+    producer.produce(1)
+    [trial] = experiment.fetch_trials()
+    complete(experiment, trial, 1.5)
+    producer.update()  # observes the completed trial -> observe timing
+
+    suggest = experiment.storage.fetch_timings(experiment, op="suggest")
+    observe = experiment.storage.fetch_timings(experiment, op="observe")
+    assert len(suggest) >= 1 and suggest[0]["count"] == 1
+    assert suggest[0]["duration"] >= 0.0
+    assert len(observe) == 1 and observe[0]["count"] == 1
