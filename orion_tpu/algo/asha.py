@@ -194,6 +194,13 @@ class ASHA(BaseAlgorithm):
                 return promoted
         return None
 
+    def _new_cube(self, num):
+        """Unit-cube rows for fresh bottom-rung points — ONE batched device
+        draw here; the model-based subclass (`asha_bo`) overrides this with a
+        GP acquisition over a fidelity-augmented posterior."""
+        key = self.next_key()
+        return np.asarray(jax.random.uniform(key, (num, self.space.n_cols)))
+
     def _sample_new(self, num):
         # Softmax over negative bottom-rung occupancy chooses a bracket per
         # point (reference `asha.py:191-198`), vectorized host-side; the
@@ -204,12 +211,12 @@ class ASHA(BaseAlgorithm):
         logits = -sizes  # fewer points -> more likely
         probs = np.exp(logits - logits.max())
         probs /= probs.sum()
-        bracket_key, sample_key = jax.random.split(self.next_key())
+        bracket_key = self.next_key()
         draws = np.asarray(jax.random.uniform(bracket_key, (num,)))
         bracket_ids = np.minimum(
             np.searchsorted(np.cumsum(probs), draws), len(self.brackets) - 1
         )
-        u = np.asarray(jax.random.uniform(sample_key, (num, self.space.n_cols)))
+        u = self._new_cube(num)
         arrays = self.space.decode_flat_np(u)
         out = []
         for i, params in enumerate(self.space.arrays_to_params(arrays)):

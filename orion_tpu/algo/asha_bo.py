@@ -1,0 +1,208 @@
+"""ASHA-BO: multi-fidelity Bayesian optimization under ASHA scheduling.
+
+No reference counterpart (Oríon v0.1.7's ASHA samples new points uniformly,
+`src/orion/algo/asha.py:191-198`); this is the round-1 verdict #10 stretch:
+BASELINE config #5 (Ackley-50D, q=4096, fidelity rungs) runs model-based
+instead of random-under-ASHA.
+
+Design (BOHB-flavored, TPU-first):
+
+- ASHA's bracket/rung machinery is inherited unchanged — promotion
+  scheduling, dedup, bracket softmax all stay host-side.
+- New bottom-rung points come from a GP fit on EVERY observation at EVERY
+  fidelity, with the fidelity attached as one extra input column
+  s = log(fid/low) / log(high/low) in [0, 1] (geometric rungs -> uniform in
+  log space).  Low-fidelity evaluations are cheap, plentiful, and
+  correlated with the truth; the learned lengthscale over s decides how
+  much to trust them.
+- Acquisition: random-Fourier-feature Thompson over a candidate set
+  (global uniform + gaussian ball around the incumbent), scored at s = 1
+  (max fidelity) — we select points by their predicted FULL-budget value.
+  One fused jit per suggest round, same engine as `tpu_bo`.
+"""
+
+import logging
+
+import numpy as np
+
+from orion_tpu.algo.asha import ASHA
+from orion_tpu.algo.base import algo_registry
+from orion_tpu.algo.sampling import clamp_objectives
+from orion_tpu.algo.tpu_bo import run_suggest_step
+
+log = logging.getLogger(__name__)
+
+
+@algo_registry.register("asha_bo")
+class ASHABO(ASHA):
+    """ASHA scheduling + fidelity-aware GP sampling.
+
+    Parameters beyond ASHA's: ``n_init`` random bottom-rung points before
+    the GP engages; ``n_candidates``, ``fit_steps``, ``kernel``, ``acq``,
+    ``local_frac``/``local_sigma`` as in ``tpu_bo``.
+    """
+
+    def __init__(
+        self,
+        space,
+        seed=None,
+        num_rungs=None,
+        num_brackets=1,
+        reduction_factor=None,
+        n_init=32,
+        n_candidates=8192,
+        kernel="matern52",
+        acq="thompson",
+        fit_steps=40,
+        beta=2.0,
+        local_frac=0.5,
+        local_sigma=0.1,
+    ):
+        super().__init__(
+            space,
+            seed=seed,
+            num_rungs=num_rungs,
+            num_brackets=num_brackets,
+            reduction_factor=reduction_factor,
+        )
+        self._params.update(
+            n_init=n_init, n_candidates=n_candidates, kernel=kernel, acq=acq,
+            fit_steps=fit_steps, beta=beta, local_frac=local_frac,
+            local_sigma=local_sigma,
+        )
+        self.n_init = n_init
+        self.n_candidates = n_candidates
+        self.kernel = kernel
+        self.acq = acq
+        self.fit_steps = fit_steps
+        self.beta = beta
+        self.local_frac = local_frac
+        self.local_sigma = local_sigma
+        fid = space.fidelity
+        self._log_low = float(np.log(max(fid.low, 1)))
+        self._log_span = float(
+            max(np.log(max(fid.high, 1)) - self._log_low, 1e-9)
+        )
+        d = space.n_cols
+        self._mf_x = np.zeros((0, d), dtype=np.float32)  # unit-cube points
+        self._mf_s = np.zeros((0,), dtype=np.float32)  # normalized fidelity
+        self._mf_y = np.zeros((0,), dtype=np.float32)
+        self._gp_state = None
+        # Trust-region-style local radius (TuRBO-lite): the GP's global
+        # signal is weak in high dimensions, so progress rides the local
+        # ball around the incumbent — expand it while improving, shrink it
+        # when stalled.
+        self._sigma = local_sigma
+        self._best_seen = np.inf
+
+    def __deepcopy__(self, memo):
+        """The producer deepcopies the algorithm every round for its naive
+        copy; share what is immutable-by-rebinding — the fitted GP state
+        (n_pad x n_pad Cholesky), the observation arrays (appends rebind via
+        np.concatenate, never mutate), and the Space — as TPUBO does."""
+        import copy as _copy
+
+        cls = type(self)
+        clone = cls.__new__(cls)
+        memo[id(self)] = clone
+        shared = ("_gp_state", "space", "_mf_x", "_mf_s", "_mf_y")
+        for key, value in self.__dict__.items():
+            if key in shared:
+                setattr(clone, key, value)
+            else:
+                setattr(clone, key, _copy.deepcopy(value, memo))
+        return clone
+
+    # --- observation ---------------------------------------------------------
+    def _fid_norm(self, fidelity):
+        return (np.log(max(float(fidelity), 1.0)) - self._log_low) / self._log_span
+
+    def observe(self, params_list, results):
+        super().observe(params_list, results)  # rung bookkeeping
+        valid, svals, yvals = [], [], []
+        for params, result in zip(params_list, results):
+            objective = result.get("objective")
+            if objective is None:
+                continue
+            valid.append(params)
+            svals.append(self._fid_norm(params.get(self.fidelity_name, 1)))
+            yvals.append(float(objective))
+        if not valid:
+            return
+        y = clamp_objectives(np.asarray(yvals, dtype=np.float64), self._mf_y)
+        if y is None:
+            return
+        # One batched codec call for the whole batch (q can be 4096) —
+        # per-point encode would cost O(batch * dims) python overhead.
+        rows = self.space.encode_flat_np(self.space.params_to_arrays(valid))
+        self._mf_x = np.concatenate(
+            [self._mf_x, np.asarray(rows, dtype=np.float32)]
+        )
+        self._mf_s = np.concatenate(
+            [self._mf_s, np.asarray(svals, dtype=np.float32)]
+        )
+        self._mf_y = np.concatenate([self._mf_y, y.astype(np.float32)])
+        batch_best = float(np.min(y))
+        if batch_best < self._best_seen - 1e-9:
+            self._best_seen = batch_best
+            self._sigma = min(self._sigma * 1.5, 0.4)
+        else:
+            self._sigma = max(self._sigma * 0.7, 0.005)
+
+    # --- model-based sampling -----------------------------------------------
+    def _new_cube(self, num):
+        n = self._mf_x.shape[0]
+        if n < self.n_init:
+            return super()._new_cube(num)
+        # Augmented inputs [x | s]; the fused step pads/buckets internally.
+        x_aug = np.concatenate([self._mf_x, self._mf_s[:, None]], axis=1)
+        # Incumbent = best observation at the highest observed fidelity tier.
+        top = self._mf_s >= self._mf_s.max() - 1e-6
+        pool_idx = np.nonzero(top)[0]
+        best_row = pool_idx[int(np.argmin(self._mf_y[pool_idx]))]
+        rows, state = run_suggest_step(
+            self.next_key(),
+            x_aug,
+            self._mf_y,
+            self._mf_x[best_row],
+            self._gp_state,
+            num,
+            n_candidates=self.n_candidates,
+            kernel=self.kernel,
+            acq=self.acq,
+            fit_steps=self.fit_steps,
+            local_frac=self.local_frac,
+            # Quantized to a pow-2 ladder: local_sigma is a STATIC arg of the
+            # fused jit, and a freely-varying value would recompile per round.
+            local_sigma=float(2.0 ** round(np.log2(self._sigma))),
+            beta=self.beta,
+            # Fidelity is context, pinned to s=1 when scoring: selection
+            # optimizes predicted FULL-budget value; the rung machinery then
+            # assigns the actual bottom-rung fidelity.
+            fixed_tail_cols=1,
+        )
+        self._gp_state = state
+        return rows
+
+    # --- state ---------------------------------------------------------------
+    def state_dict(self):
+        out = super().state_dict()
+        out["mf_x"] = self._mf_x.tolist()
+        out["mf_s"] = self._mf_s.tolist()
+        out["mf_y"] = self._mf_y.tolist()
+        out["sigma"] = self._sigma
+        out["best_seen"] = (
+            None if np.isinf(self._best_seen) else self._best_seen
+        )
+        return out
+
+    def set_state(self, state):
+        super().set_state(state)
+        d = self.space.n_cols
+        self._mf_x = np.asarray(state.get("mf_x", []), dtype=np.float32).reshape(-1, d)
+        self._mf_s = np.asarray(state.get("mf_s", []), dtype=np.float32)
+        self._mf_y = np.asarray(state.get("mf_y", []), dtype=np.float32)
+        self._sigma = state.get("sigma", self.local_sigma)
+        best = state.get("best_seen")
+        self._best_seen = np.inf if best is None else float(best)
+        self._gp_state = None

@@ -153,6 +153,7 @@ class BaseAlgorithm:
 _BUILTIN_MODULES = (
     "random_search",
     "asha",
+    "asha_bo",
     "hyperband",
     "grid_search",
     "tpe",

@@ -134,28 +134,14 @@ class TPUBO(BaseAlgorithm):
         # + acquisition + on-device dedup/EI-fill + gather.  One dispatch and
         # one (q, d) transfer per suggest — dispatch latency otherwise
         # dominates (each host->device round trip costs ~ms).
-        n_pad = _next_pow2(n)
-        d = self.space.n_cols
-        x = np.zeros((n_pad, d), dtype=np.float32)
-        y = np.zeros((n_pad,), dtype=np.float32)
-        mask = np.zeros((n_pad,), dtype=np.float32)
-        x[:n] = self._x
-        y[:n] = self._y
-        mask[:n] = 1.0
-        warm = self._gp_state.hypers if self._gp_state is not None else init_hypers(d)
-        best_x = jnp.asarray(self._x[int(np.argmin(self._y))])
-        # Bucket q to a power of two: q is a static arg of the fused jit, and
-        # the producer's retry loop shrinks its request per round — each
-        # distinct q would otherwise recompile the whole fit+acquire graph.
-        q_pad = _next_pow2(num, floor=8)
-        rows, state = _suggest_step(
+        best_x = self._x[int(np.argmin(self._y))]
+        rows, state = run_suggest_step(
             self.next_key(),
-            jnp.asarray(x),
-            jnp.asarray(y),
-            jnp.asarray(mask),
+            self._x,
+            self._y,
             best_x,
-            warm,
-            q=q_pad,
+            self._gp_state,
+            num,
             n_candidates=self.n_candidates,
             kernel=self.kernel,
             acq=self.acq,
@@ -166,9 +152,7 @@ class TPUBO(BaseAlgorithm):
         )
         self._gp_state = state
         self._gp_dirty = False
-        # Dedup ordered unique draws first, so the first `num` rows are the
-        # ones the un-padded call would have returned.
-        return np.asarray(rows)[:num]
+        return rows
 
     def _suggest_cube_sharded(self, num):
         state = self._fit()
@@ -259,6 +243,60 @@ def _make_candidates(key, n_candidates, n_dims, best_x, local_frac, local_sigma)
     return jnp.concatenate([global_c, reflect_unit(local_c)], axis=0)
 
 
+def run_suggest_step(
+    key,
+    x_obs,
+    y_obs,
+    best_x,
+    warm_state,
+    num,
+    *,
+    n_candidates,
+    kernel,
+    acq,
+    fit_steps,
+    local_frac,
+    local_sigma,
+    beta,
+    fixed_tail_cols=0,
+):
+    """Host wrapper around the fused jit: pow-2 pad the observation buffers,
+    warm-start from a previous GPState, bucket q (a static arg — the
+    producer's retry loop shrinks its request per round and each distinct q
+    would otherwise recompile the whole graph), and slice the rows back.
+    Shared by ``tpu_bo`` and the multi-fidelity ``asha_bo``.
+    """
+    n, width = np.asarray(x_obs).shape
+    n_pad = _next_pow2(n)
+    x = np.zeros((n_pad, width), dtype=np.float32)
+    y = np.zeros((n_pad,), dtype=np.float32)
+    mask = np.zeros((n_pad,), dtype=np.float32)
+    x[:n] = x_obs
+    y[:n] = y_obs
+    mask[:n] = 1.0
+    warm = warm_state.hypers if warm_state is not None else init_hypers(width)
+    rows, state = _suggest_step(
+        key,
+        jnp.asarray(x),
+        jnp.asarray(y),
+        jnp.asarray(mask),
+        jnp.asarray(best_x),
+        warm,
+        q=_next_pow2(num, floor=8),
+        n_candidates=n_candidates,
+        kernel=kernel,
+        acq=acq,
+        fit_steps=fit_steps,
+        local_frac=local_frac,
+        local_sigma=local_sigma,
+        beta=beta,
+        fixed_tail_cols=fixed_tail_cols,
+    )
+    # Dedup ordered unique draws first, so the first `num` rows are the ones
+    # the un-padded call would have returned.
+    return np.asarray(rows)[:num], state
+
+
 def _dedup_fill_device(idx, ei_rank, q):
     """On-device first-occurrence dedup of ``idx`` with EI-ranked backfill.
 
@@ -294,6 +332,7 @@ def _dedup_fill_device(idx, ei_rank, q):
         "local_frac",
         "local_sigma",
         "beta",
+        "fixed_tail_cols",
     ),
 )
 def _suggest_step(
@@ -312,26 +351,56 @@ def _suggest_step(
     local_frac,
     local_sigma,
     beta,
+    fixed_tail_cols=0,
 ):
-    """The whole GP-BO suggest round as ONE compiled computation."""
+    """The whole GP-BO suggest round as ONE compiled computation.
+
+    ``fixed_tail_cols``: the last k input columns are context, not free
+    variables — candidates are generated over the leading columns only and
+    the tail is pinned to 1.0 when scoring (multi-fidelity BO pins the
+    fidelity column to max budget so selection optimizes the predicted
+    FULL-budget value).  Returned rows include only the free columns.
+    """
     state = fit_gp(x, y, mask, kind=kernel, n_steps=fit_steps, init=warm_hypers)
     k_cand, k_acq = jax.random.split(key)
-    candidates = _make_candidates(
-        k_cand, n_candidates, x.shape[1], best_x, local_frac, local_sigma
+    d_free = x.shape[1] - fixed_tail_cols
+    free_candidates = _make_candidates(
+        k_cand, n_candidates, d_free, best_x[:d_free], local_frac, local_sigma
     )
+    if fixed_tail_cols:
+        candidates = jnp.concatenate(
+            [
+                free_candidates,
+                jnp.ones((n_candidates, fixed_tail_cols), free_candidates.dtype),
+            ],
+            axis=1,
+        )
+    else:
+        candidates = free_candidates
+    y_norm = (state.y - state.y_mean) / state.y_std
+    if fixed_tail_cols:
+        # Candidates are scored at max context (tail pinned to 1), so the EI
+        # incumbent must be the best observation AT the top context tier — a
+        # lucky low-fidelity value would otherwise be unattainable for every
+        # candidate and flatten EI to ~0.
+        s_col = x[:, -1]
+        s_max = jnp.max(jnp.where(mask > 0, s_col, -jnp.inf))
+        top = (mask > 0) & (s_col >= s_max - 1e-6)
+        best = jnp.min(jnp.where(top, y_norm, jnp.inf))
+    else:
+        best = jnp.min(jnp.where(state.mask > 0, y_norm, jnp.inf))
     if acq == "joint_thompson":
         idx = joint_thompson(k_acq, state, candidates, q, kind=kernel)
     else:
-        idx = acquire(k_acq, state, candidates, q, kind=kernel, acq=acq, beta=beta)
+        idx = acquire(
+            k_acq, state, candidates, q, kind=kernel, acq=acq, best=best, beta=beta
+        )
     mean, std = posterior_norm(state, candidates, kind=kernel)
-    best = jnp.min(
-        jnp.where(state.mask > 0, (state.y - state.y_mean) / state.y_std, jnp.inf)
-    )
     ei_rank = select_q(
         expected_improvement(mean, std, best), min(4 * q, n_candidates)
     )
     final_idx = _dedup_fill_device(idx, ei_rank, q)
-    return jnp.take(candidates, final_idx, axis=0), state
+    return jnp.take(free_candidates, final_idx, axis=0), state
 
 
 @partial(jax.jit, static_argnums=(3, 4, 5))

@@ -44,6 +44,16 @@ PRESETS = {
         fn="ackley50", algorithm="asha", strategy="NoParallelStrategy",
         max_trials=4096, batch_size=4096,
     ),
+    # Config #5 model-based (round-1 verdict #10): fidelity-aware GP sampling
+    # under the same ASHA scheduling/budget — compare against asha-ackley50.
+    "asha_bo-ackley50": dict(
+        priors={**_uniform_priors(50), "budget": "fidelity(1, 16, 4)"},
+        fn="ackley50",
+        algorithm={"asha_bo": {"n_init": 128, "n_candidates": 8192,
+                               "fit_steps": 30, "local_frac": 0.7}},
+        strategy="NoParallelStrategy",
+        max_trials=4096, batch_size=4096,
+    ),
 }
 
 

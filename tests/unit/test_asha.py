@@ -121,3 +121,106 @@ def test_unknown_point_routes_to_bottom_rung_bracket():
     hb.register_suggestion({"x": 0.42, "epochs": 3})
     assert len(hb.brackets[1].rungs[0]["results"]) == 1  # NOT bracket 0 rung 1
     assert len(hb.brackets[0].rungs[1]["results"]) == 0
+
+
+# --- asha_bo: multi-fidelity BO under ASHA scheduling -----------------------
+
+
+def _mf_space(dims=4):
+    from orion_tpu.space.dsl import build_space
+
+    priors = {f"x{i}": "uniform(0, 1)" for i in range(dims)}
+    priors["epochs"] = "fidelity(1, 16, 4)"
+    return build_space(priors)
+
+
+def test_asha_bo_suggest_observe_cycle():
+    from orion_tpu.algo.base import create_algo
+
+    space = _mf_space()
+    algo = create_algo(
+        space,
+        {"asha_bo": {"n_init": 8, "n_candidates": 256, "fit_steps": 5}},
+        seed=0,
+    )
+    rng = __import__("numpy").random.default_rng(0)
+    for _ in range(4):
+        params = algo.suggest(8)
+        assert params and all(p["epochs"] in (1, 4, 16) for p in params)
+        algo.observe(
+            params, [{"objective": float(rng.normal())} for _ in params]
+        )
+    # Past n_init the GP path engages and still yields valid rung points.
+    params = algo.suggest(4)
+    assert params and all(0.0 <= p["x0"] <= 1.0 for p in params)
+    assert algo._mf_x.shape[0] >= 8
+
+
+def test_asha_bo_low_fidelity_feeds_the_model():
+    """Observations at EVERY rung land in the GP data with a normalized
+    log-fidelity column (the point of multi-fidelity BO)."""
+    import numpy as np
+
+    from orion_tpu.algo.base import create_algo
+
+    space = _mf_space()
+    algo = create_algo(space, {"asha_bo": {"n_init": 100}}, seed=0)
+    for fid, s_expect in ((1, 0.0), (4, 0.5), (16, 1.0)):
+        params = {f"x{i}": 0.5 for i in range(4)}
+        params["epochs"] = fid
+        algo.observe([params], [{"objective": 1.0}])
+        assert algo._mf_s[-1] == __import__("pytest").approx(s_expect, abs=1e-6)
+    assert algo._mf_x.shape == (3, 4)
+    assert np.all((algo._mf_x >= 0) & (algo._mf_x <= 1))
+
+
+def test_asha_bo_state_roundtrip():
+    from orion_tpu.algo.base import create_algo
+
+    space = _mf_space()
+    algo = create_algo(
+        space, {"asha_bo": {"n_init": 4, "n_candidates": 128, "fit_steps": 3}},
+        seed=0,
+    )
+    params = algo.suggest(6)
+    algo.observe(params, [{"objective": float(i)} for i in range(len(params))])
+    state = algo.state_dict()
+
+    clone = create_algo(
+        space, {"asha_bo": {"n_init": 4, "n_candidates": 128, "fit_steps": 3}},
+        seed=0,
+    )
+    clone.set_state(state)
+    assert clone._mf_x.shape == algo._mf_x.shape
+    assert clone._sigma == algo._sigma
+    assert clone._best_seen == algo._best_seen
+    out = clone.suggest(4)
+    assert out and len(out) == 4
+
+
+def test_asha_bo_beats_plain_asha_on_ackley():
+    """Round-1 verdict #10 done-criterion, scaled to test size: model-based
+    sampling beats uniform sampling under identical ASHA scheduling/budget."""
+    import numpy as np
+
+    from orion_tpu.benchmarks.functions import ackley
+    from orion_tpu.client.experiment import optimize
+
+    def run(algo, seed, tag):
+        priors = {f"x{i:02d}": "uniform(0, 1)" for i in range(10)}
+        priors["budget"] = "fidelity(1, 16, 4)"
+        stats = optimize(
+            fn=None, priors=priors, max_trials=150, batch_size=50,
+            algorithm=algo, strategy="NoParallelStrategy", seed=seed,
+            name=f"mfcmp-{tag}-{seed}", batch_eval=lambda cube: ackley(cube),
+        )
+        return stats["best_evaluation"]
+
+    seeds = (1, 2)
+    asha = np.mean([run("asha", s, "a") for s in seeds])
+    asha_bo = np.mean([
+        run({"asha_bo": {"n_init": 50, "n_candidates": 1024, "fit_steps": 15,
+                          "local_frac": 0.7}}, s, "b")
+        for s in seeds
+    ])
+    assert asha_bo < asha, (asha_bo, asha)
