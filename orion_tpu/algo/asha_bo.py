@@ -54,6 +54,7 @@ class ASHABO(ASHA):
         kernel="matern52",
         acq="thompson",
         fit_steps=40,
+        refit_steps=None,
         beta=2.0,
         local_frac=0.5,
         local_sigma=0.1,
@@ -67,14 +68,19 @@ class ASHABO(ASHA):
         )
         self._params.update(
             n_init=n_init, n_candidates=n_candidates, kernel=kernel, acq=acq,
-            fit_steps=fit_steps, beta=beta, local_frac=local_frac,
-            local_sigma=local_sigma,
+            fit_steps=fit_steps, refit_steps=refit_steps, beta=beta,
+            local_frac=local_frac, local_sigma=local_sigma,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
         self.kernel = kernel
         self.acq = acq
         self.fit_steps = fit_steps
+        # Default = full fit_steps: on latency-bound links the fused round
+        # costs the same regardless, and fewer steps measurably cost regret.
+        # Opt in where GP fitting genuinely dominates (large pads, local
+        # devices).
+        self.refit_steps = refit_steps if refit_steps is not None else fit_steps
         self.beta = beta
         self.local_frac = local_frac
         self.local_sigma = local_sigma
@@ -171,6 +177,7 @@ class ASHABO(ASHA):
             kernel=self.kernel,
             acq=self.acq,
             fit_steps=self.fit_steps,
+            refit_steps=self.refit_steps,
             local_frac=self.local_frac,
             # Quantized to a pow-2 ladder: local_sigma is a STATIC arg of the
             # fused jit, and a freely-varying value would recompile per round.

@@ -28,7 +28,7 @@ from orion_tpu.algo.gp.acquisition import (
 )
 from orion_tpu.algo.gp.gp import fit_gp, init_hypers, posterior_norm
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
-from orion_tpu.parallel import device_mesh, shard_candidates
+from orion_tpu.parallel import candidate_sharding, device_mesh
 
 
 def _next_pow2(n, floor=64):
@@ -49,7 +49,10 @@ class TPUBO(BaseAlgorithm):
         uniform exploration and gaussian perturbations around incumbents).
     acq: "thompson" (default; diverse q-batches), "joint_thompson", "ei", "ucb".
     kernel: "matern52" (default) or "rbf".
-    fit_steps: adam steps on the marginal likelihood per (re)fit.
+    fit_steps: adam steps on the marginal likelihood for the FIRST fit.
+    refit_steps: steps for warm-started refits (default: fit_steps).  Each
+        round resumes from the previous round's hyperparameters, so fewer
+        refit steps are viable where GP fitting dominates the round.
     local_frac: fraction of candidates drawn around the current best point.
     n_devices: shard candidates over this many devices (None = all visible).
     """
@@ -63,6 +66,7 @@ class TPUBO(BaseAlgorithm):
         acq="thompson",
         kernel="matern52",
         fit_steps=50,
+        refit_steps=None,
         beta=2.0,
         local_frac=0.5,
         local_sigma=0.1,
@@ -77,6 +81,7 @@ class TPUBO(BaseAlgorithm):
             acq=acq,
             kernel=kernel,
             fit_steps=fit_steps,
+            refit_steps=refit_steps,
             beta=beta,
             local_frac=local_frac,
             local_sigma=local_sigma,
@@ -86,6 +91,11 @@ class TPUBO(BaseAlgorithm):
         self.acq = acq
         self.kernel = kernel
         self.fit_steps = fit_steps
+        # Default = full fit_steps: on latency-bound links the fused round
+        # costs the same regardless, and fewer steps measurably cost regret.
+        # Opt in where GP fitting genuinely dominates (large pads, local
+        # devices).
+        self.refit_steps = refit_steps if refit_steps is not None else fit_steps
         self.beta = beta
         self.local_frac = local_frac
         self.local_sigma = local_sigma
@@ -95,7 +105,6 @@ class TPUBO(BaseAlgorithm):
         self._x = np.zeros((0, d), dtype=np.float32)
         self._y = np.zeros((0,), dtype=np.float32)
         self._gp_state = None
-        self._gp_dirty = True
 
     def __deepcopy__(self, memo):
         """Producer deepcopies the algorithm each round for the naive copy;
@@ -119,21 +128,18 @@ class TPUBO(BaseAlgorithm):
             return
         self._x = np.concatenate([self._x, np.asarray(cube, dtype=np.float32)])
         self._y = np.concatenate([self._y, np.asarray(objectives, dtype=np.float32)])
-        self._gp_dirty = True
 
     # --- suggestion ---------------------------------------------------------
     def _suggest_cube(self, num):
         n = self._x.shape[0]
         if n < self.n_init:
             return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
-        if self._mesh is not None:
-            # The sharded path keeps separate dispatch stages so candidates
-            # can be placed on the mesh between generation and acquisition.
-            return self._suggest_cube_sharded(num)
         # Single fused jit call: warm-started GP refit + candidate generation
         # + acquisition + on-device dedup/EI-fill + gather.  One dispatch and
         # one (q, d) transfer per suggest — dispatch latency otherwise
-        # dominates (each host->device round trip costs ~ms).
+        # dominates (each host->device round trip costs ~ms).  With a mesh,
+        # the same compiled step shards the candidate axis over it (SPMD
+        # collectives inserted by XLA, see orion_tpu.parallel).
         best_x = self._x[int(np.argmin(self._y))]
         rows, state = run_suggest_step(
             self.next_key(),
@@ -146,72 +152,14 @@ class TPUBO(BaseAlgorithm):
             kernel=self.kernel,
             acq=self.acq,
             fit_steps=self.fit_steps,
+            refit_steps=self.refit_steps,
             local_frac=self.local_frac,
             local_sigma=self.local_sigma,
             beta=self.beta,
+            mesh=self._mesh,
         )
         self._gp_state = state
-        self._gp_dirty = False
         return rows
-
-    def _suggest_cube_sharded(self, num):
-        state = self._fit()
-        key_cand, key_acq = jax.random.split(self.next_key())
-        best_x = self._x[int(np.argmin(self._y))]
-        candidates = _make_candidates(
-            key_cand,
-            self.n_candidates,
-            self.space.n_cols,
-            jnp.asarray(best_x),
-            self.local_frac,
-            self.local_sigma,
-        )
-        candidates = shard_candidates(candidates, self._mesh)
-        if self.acq == "joint_thompson":
-            idx = _acquire_joint(key_acq, state, candidates, num, self.kernel)
-        else:
-            idx = _acquire(key_acq, state, candidates, num, self.kernel, self.acq, self.beta)
-        idx = self._dedup_fill(idx, state, candidates, num)
-        return jnp.take(candidates, jnp.asarray(idx), axis=0)
-
-    def _dedup_fill(self, idx, state, candidates, num):
-        """A confident posterior makes all Thompson draws argmin at the same
-        candidate; q duplicate suggestions would spin the producer on
-        DuplicateKeyError.  Keep first occurrences, fill the rest with the
-        top distinct candidates by EI.  Vectorized: one np.unique pass per
-        call instead of a python loop over q indices."""
-        idx = np.asarray(idx)
-        _, first = np.unique(idx, return_index=True)
-        out = idx[np.sort(first)]
-        if out.size < num:
-            ranked = np.asarray(
-                _acquire(
-                    self.next_key(), state, candidates,
-                    min(4 * num, candidates.shape[0]), self.kernel, "ei", self.beta,
-                )
-            )
-            fill = ranked[~np.isin(ranked, out)]
-            out = np.concatenate([out, fill])
-        return out[:num]
-
-    def _fit(self):
-        if self._gp_state is not None and not self._gp_dirty:
-            return self._gp_state
-        n = self._x.shape[0]
-        n_pad = _next_pow2(n)
-        x = np.zeros((n_pad, self.space.n_cols), dtype=np.float32)
-        y = np.zeros((n_pad,), dtype=np.float32)
-        mask = np.zeros((n_pad,), dtype=np.float32)
-        x[:n] = self._x
-        y[:n] = self._y
-        mask[:n] = 1.0
-        warm = self._gp_state.hypers if self._gp_state is not None else None
-        self._gp_state = fit_gp(
-            jnp.asarray(x), jnp.asarray(y), jnp.asarray(mask),
-            kind=self.kernel, n_steps=self.fit_steps, init=warm,
-        )
-        self._gp_dirty = False
-        return self._gp_state
 
     # --- state --------------------------------------------------------------
     def state_dict(self):
@@ -225,7 +173,7 @@ class TPUBO(BaseAlgorithm):
         d = self.space.n_cols
         self._x = np.asarray(state["x"], dtype=np.float32).reshape(-1, d)
         self._y = np.asarray(state["y"], dtype=np.float32)
-        self._gp_dirty = True
+        self._gp_state = None  # refit (cold) on the next suggest
 
 
 @partial(jax.jit, static_argnums=(1, 2, 4))
@@ -255,16 +203,19 @@ def run_suggest_step(
     kernel,
     acq,
     fit_steps,
+    refit_steps=None,
     local_frac,
     local_sigma,
     beta,
     fixed_tail_cols=0,
+    mesh=None,
 ):
     """Host wrapper around the fused jit: pow-2 pad the observation buffers,
-    warm-start from a previous GPState, bucket q (a static arg — the
-    producer's retry loop shrinks its request per round and each distinct q
-    would otherwise recompile the whole graph), and slice the rows back.
-    Shared by ``tpu_bo`` and the multi-fidelity ``asha_bo``.
+    warm-start from a previous GPState (warm refits run ``refit_steps``
+    optimizer steps, cold first fits ``fit_steps``), bucket q (a static arg
+    — the producer's retry loop shrinks its request per round and each
+    distinct q would otherwise recompile the whole graph), and slice the
+    rows back.  Shared by ``tpu_bo`` and the multi-fidelity ``asha_bo``.
     """
     n, width = np.asarray(x_obs).shape
     n_pad = _next_pow2(n)
@@ -275,6 +226,8 @@ def run_suggest_step(
     y[:n] = y_obs
     mask[:n] = 1.0
     warm = warm_state.hypers if warm_state is not None else init_hypers(width)
+    if warm_state is not None and refit_steps is not None:
+        fit_steps = refit_steps
     rows, state = _suggest_step(
         key,
         jnp.asarray(x),
@@ -291,6 +244,7 @@ def run_suggest_step(
         local_sigma=local_sigma,
         beta=beta,
         fixed_tail_cols=fixed_tail_cols,
+        mesh=mesh,
     )
     # Dedup ordered unique draws first, so the first `num` rows are the ones
     # the un-padded call would have returned.
@@ -333,6 +287,7 @@ def _dedup_fill_device(idx, ei_rank, q):
         "local_sigma",
         "beta",
         "fixed_tail_cols",
+        "mesh",
     ),
 )
 def _suggest_step(
@@ -352,6 +307,7 @@ def _suggest_step(
     local_sigma,
     beta,
     fixed_tail_cols=0,
+    mesh=None,
 ):
     """The whole GP-BO suggest round as ONE compiled computation.
 
@@ -367,6 +323,13 @@ def _suggest_step(
     free_candidates = _make_candidates(
         k_cand, n_candidates, d_free, best_x[:d_free], local_frac, local_sigma
     )
+    if mesh is not None:
+        # Data-parallel over the candidate axis: XLA's SPMD partitioner
+        # splits generation+scoring per shard and inserts the ICI
+        # collectives for the cross-candidate argmin/top-k reductions.
+        free_candidates = jax.lax.with_sharding_constraint(
+            free_candidates, candidate_sharding(mesh)
+        )
     if fixed_tail_cols:
         candidates = jnp.concatenate(
             [
@@ -402,12 +365,3 @@ def _suggest_step(
     final_idx = _dedup_fill_device(idx, ei_rank, q)
     return jnp.take(free_candidates, final_idx, axis=0), state
 
-
-@partial(jax.jit, static_argnums=(3, 4, 5))
-def _acquire(key, state, candidates, q, kernel, acq, beta):
-    return acquire(key, state, candidates, q, kind=kernel, acq=acq, beta=beta)
-
-
-@partial(jax.jit, static_argnums=(3, 4))
-def _acquire_joint(key, state, candidates, q, kernel):
-    return joint_thompson(key, state, candidates, q, kind=kernel)

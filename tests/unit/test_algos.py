@@ -141,3 +141,35 @@ def test_hyperband_brackets_receive_observations_and_finish():
     assert hb.is_done  # every bracket's top rung eventually fills
     for i, b in enumerate(hb.brackets):
         assert b.rungs[-1]["results"], f"bracket {i} top rung never filled"
+
+
+def test_refit_steps_gates_on_warm_state(monkeypatch):
+    """Cold first fit uses fit_steps; warm refits use refit_steps."""
+    import numpy as np
+
+    import orion_tpu.algo.tpu_bo as tb
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    seen = []
+    real = tb._suggest_step
+
+    def recording(*args, **kwargs):
+        seen.append(kwargs["fit_steps"])
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(tb, "_suggest_step", recording)
+
+    space = build_space({"x": "uniform(0, 1)", "y": "uniform(0, 1)"})
+    algo = create_algo(
+        space,
+        {"tpu_bo": {"n_init": 4, "n_candidates": 128, "fit_steps": 12,
+                     "refit_steps": 3}},
+        seed=0,
+    )
+    rng = np.random.default_rng(0)
+    params = space.sample(0, n=4)
+    algo.observe(params, [{"objective": float(v)} for v in rng.normal(size=4)])
+    algo.suggest(2)  # cold: full fit
+    params = algo.suggest(2)  # warm: cheap refit
+    assert seen == [12, 3], seen

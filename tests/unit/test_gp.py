@@ -116,7 +116,7 @@ def test_rff_thompson_selects_low_posterior_mean():
     idx = np.asarray(rff_thompson(jax.random.PRNGKey(0), state, cands, 32))
     # Selected candidates should skew toward low predicted mean.  (Draws MAY
     # collapse to few points when the posterior is confident — batch
-    # uniqueness is guaranteed one level up, in TPUBO._dedup_fill.)
+    # uniqueness is guaranteed one level up, by the fused step dedup.)
     mean_all, _ = posterior_norm(state, cands)
     sel_mean = np.asarray(mean_all)[idx].mean()
     assert sel_mean < float(np.asarray(mean_all).mean())
