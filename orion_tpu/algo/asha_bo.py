@@ -76,11 +76,9 @@ class ASHABO(ASHA):
         self.kernel = kernel
         self.acq = acq
         self.fit_steps = fit_steps
-        # Default = full fit_steps: on latency-bound links the fused round
-        # costs the same regardless, and fewer steps measurably cost regret.
-        # Opt in where GP fitting genuinely dominates (large pads, local
-        # devices).
-        self.refit_steps = refit_steps if refit_steps is not None else fit_steps
+        # None = warm refits also use fit_steps (run_suggest_step owns the
+        # default); opt in where GP fitting genuinely dominates the round.
+        self.refit_steps = refit_steps
         self.beta = beta
         self.local_frac = local_frac
         self.local_sigma = local_sigma

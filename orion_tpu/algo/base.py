@@ -37,7 +37,15 @@ class BaseAlgorithm:
         self.space = space
         self._params = dict(params)
         self._seed = seed
-        self.rng_key = jax.random.PRNGKey(seed if seed is not None else 0)
+        if seed is None:
+            # Each unseeded instance gets its own stream (as the reference's
+            # unseeded numpy RandomState does): concurrent workers sharing a
+            # fixed default key would all suggest the IDENTICAL point
+            # sequence and grind on DuplicateKeyError until SampleTimeout.
+            import os
+
+            seed = int.from_bytes(os.urandom(4), "little")
+        self.rng_key = jax.random.PRNGKey(seed)
         # Observation history, host-side mirrors of device state.
         self._n_observed = 0
 

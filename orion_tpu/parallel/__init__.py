@@ -36,5 +36,7 @@ def replicated(mesh):
 
 
 def shard_candidates(candidates, mesh, axis_name=CANDIDATE_AXIS):
-    """Place candidates sharded over the mesh (no-op on a 1-device mesh)."""
+    """Place host candidates sharded over the mesh (public utility for
+    library users bringing their OWN candidate sets; the built-in engine
+    shards inside its fused jit via `candidate_sharding` instead)."""
     return jax.device_put(candidates, candidate_sharding(mesh, axis_name))

@@ -173,3 +173,23 @@ def test_refit_steps_gates_on_warm_state(monkeypatch):
     algo.suggest(2)  # cold: full fit
     params = algo.suggest(2)  # warm: cheap refit
     assert seen == [12, 3], seen
+
+
+def test_unseeded_algorithms_have_distinct_streams():
+    """Two workers building the same experiment without a seed must NOT
+    suggest identical point sequences (they would grind on
+    DuplicateKeyError until SampleTimeout — the two-workers flake)."""
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    space = build_space({"x": "uniform(0, 1)"})
+    a = create_algo(space, "random")
+    b = create_algo(space, "random")
+    pa = [p["x"] for p in a.suggest(8)]
+    pb = [p["x"] for p in b.suggest(8)]
+    assert pa != pb
+
+    # Explicit seeding is still exactly reproducible.
+    c = create_algo(space, "random", seed=7)
+    d = create_algo(space, "random", seed=7)
+    assert [p["x"] for p in c.suggest(8)] == [p["x"] for p in d.suggest(8)]
