@@ -24,6 +24,22 @@ def _uniform_priors(n_dims):
     return {f"x{i:02d}": "uniform(0, 1)" for i in range(n_dims)}
 
 
+def _mixed_lenet_objective(params):
+    """Cheap deterministic stand-in for the LeNet hparam landscape of
+    BASELINE config #4 (the real trainable example is examples/mnist_lenet.py;
+    the runner preset measures the mixed-space suggest machinery itself).
+    Optimum: lr=1e-2, batch_size=128, width=3, act='relu' -> 0."""
+    import math
+
+    act_penalty = {"relu": 0.0, "gelu": 0.1, "tanh": 0.3}[params["act"]]
+    return (
+        (math.log10(params["lr"]) + 2.0) ** 2
+        + ((params["batch_size"] - 128) / 96.0) ** 2
+        + (params["width"] - 3) ** 2 / 4.0
+        + act_penalty
+    )
+
+
 PRESETS = {
     "random-branin": dict(
         priors=_uniform_priors(2), fn="branin", algorithm="random",
@@ -33,6 +49,17 @@ PRESETS = {
         priors=_uniform_priors(6), fn="hartmann6",
         algorithm={"tpu_bo": {"n_init": 16, "n_candidates": 8192, "fit_steps": 40}},
         max_trials=192, batch_size=16,
+    ),
+    "mixed-lenet": dict(
+        priors={
+            "lr": "loguniform(1e-4, 1e-1)",
+            "batch_size": "uniform(32, 256, discrete=True)",
+            "width": "uniform(1, 4, discrete=True)",
+            "act": "choices(['relu', 'tanh', 'gelu'])",
+        },
+        fn_params=_mixed_lenet_objective, optimum=0.0,
+        algorithm={"tpu_bo": {"n_init": 16, "n_candidates": 4096, "fit_steps": 30}},
+        max_trials=128, batch_size=16,
     ),
     "thompson-rosenbrock20": dict(
         priors=_uniform_priors(20), fn="rosenbrock20",
@@ -57,17 +84,20 @@ PRESETS = {
 }
 
 
-def run_preset(name, seed=0):
-    cfg = dict(PRESETS[name])
-    spec = BENCHMARKS[cfg.pop("fn")]
-    fn = spec["fn"]
-
-    def batch_eval(cube):
-        return fn(cube)
+def run_preset(name, seed=0, **overrides):
+    cfg = {**PRESETS[name], **overrides}
+    if "fn_params" in cfg:
+        # Host-side params-dict objective (mixed spaces with categoricals).
+        fn, batch_eval = cfg.pop("fn_params"), None
+        optimum = cfg.pop("optimum")
+    else:
+        spec = BENCHMARKS[cfg.pop("fn")]
+        fn, batch_eval = None, spec["fn"]
+        optimum = spec["optimum"]
 
     t0 = time.perf_counter()
     stats = optimize(
-        fn=None,
+        fn=fn,
         priors=cfg["priors"],
         max_trials=cfg["max_trials"],
         batch_size=cfg["batch_size"],
@@ -82,7 +112,7 @@ def run_preset(name, seed=0):
     return {
         "preset": name,
         "best": best,
-        "simple_regret": (best - spec["optimum"]) if best is not None else None,
+        "simple_regret": (best - optimum) if best is not None else None,
         "trials": stats["trials_completed"],
         "wall_s": round(wall, 2),
         "suggestions_per_sec": round(stats["trials_completed"] / wall, 2),

@@ -193,3 +193,13 @@ def test_unseeded_algorithms_have_distinct_streams():
     c = create_algo(space, "random", seed=7)
     d = create_algo(space, "random", seed=7)
     assert [p["x"] for p in c.suggest(8)] == [p["x"] for p in d.suggest(8)]
+
+
+def test_mixed_lenet_preset_converges_small():
+    """BASELINE config #4 machinery: mixed Real/Integer/Categorical BO
+    through the runner's params-dict objective path."""
+    from orion_tpu.benchmarks.runner import run_preset
+
+    out = run_preset("mixed-lenet", seed=0, max_trials=48, batch_size=16)
+    assert out["trials"] == 48
+    assert out["simple_regret"] < 1.0  # random-ish is ~2-3; BO gets close fast
