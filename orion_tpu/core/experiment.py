@@ -48,6 +48,7 @@ class Experiment:
         self.algo_config = config.get("algorithms", "random")
         self.strategy_config = config.get("strategy", "MaxParallelStrategy")
         self.refers = dict(config.get("refers", {}))
+        self._last_lost_sweep = float("-inf")
         self.priors = dict(config.get("priors") or config.get("metadata", {}).get("priors", {}))
         self.space = build_space(self.priors) if self.priors else None
         self.algorithm = None
@@ -97,8 +98,19 @@ class Experiment:
             except FailedUpdate:
                 pass  # another worker got there first — fine
 
-    def reserve_trial(self):
+    def _maybe_fix_lost_trials(self):
+        """Rate-limited sweep for the reservation hot path: a trial cannot
+        become lost faster than the heartbeat window, so sweeping a q=4096
+        reservation burst 4096 times is pure collection-scan overhead."""
+        now = time.monotonic()
+        interval = max(1.0, self.heartbeat / 4.0)
+        if now - self._last_lost_sweep < interval:
+            return
+        self._last_lost_sweep = now
         self.fix_lost_trials()
+
+    def reserve_trial(self):
+        self._maybe_fix_lost_trials()
         trial = self._storage.reserve_trial(self._id)
         if trial is not None:
             trial.working_dir = self.working_dir

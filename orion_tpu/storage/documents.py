@@ -14,7 +14,6 @@ import copy
 import threading
 
 from orion_tpu.utils.exceptions import DuplicateKeyError
-from orion_tpu.utils.flatten import flatten
 
 _OPS = {
     "$ne": lambda doc_val, qv: doc_val != qv,
@@ -32,23 +31,14 @@ def _match_value(doc_val, query_val):
     return doc_val == query_val
 
 
-def _matches(flat_doc, nested_doc, query):
+def _matches(nested_doc, query):
+    """Match a query against a nested document, walking dotted paths
+    directly — flattening the whole document per candidate per query was the
+    dominant cost of every collection scan at q-batch scale."""
     for key, qv in (query or {}).items():
-        if key in flat_doc:
-            if not _match_value(flat_doc[key], qv):
-                return False
-        else:
-            # dotted key may address a whole subdocument or a missing field
-            sub = nested_doc
-            found = True
-            for part in key.split("."):
-                if isinstance(sub, dict) and part in sub:
-                    sub = sub[part]
-                else:
-                    found = False
-                    break
-            if not _match_value(sub if found else None, qv):
-                return False
+        found, value = _get_path(nested_doc, key)
+        if not _match_value(value if found else None, qv):
+            return False
     return True
 
 
@@ -63,6 +53,14 @@ def _get_path(doc, dotted):
         else:
             return False, None
     return True, node
+
+
+def _hashable(value):
+    try:
+        hash(value)
+        return value
+    except TypeError:
+        return repr(value)
 
 
 def _set_path(doc, dotted, value):
@@ -99,13 +97,32 @@ class Collection:
     def __init__(self):
         self._docs = {}  # _id -> nested document
         self._indexes = {}  # name -> (tuple of fields, unique)
+        self._unique_maps = {}  # fields -> {index key -> _id}; O(1) dup checks
         self._auto_id = 0
+
+    def __setstate__(self, state):
+        # DB files pickled by versions that predate _unique_maps must keep
+        # loading: rebuild the hash indexes from the stored docs/indexes.
+        self.__dict__.update(state)
+        if "_unique_maps" not in self.__dict__:
+            self._unique_maps = {}
+            for fields, unique in self._indexes.values():
+                if unique and fields not in self._unique_maps:
+                    self._unique_maps[fields] = {
+                        self._index_key(doc, fields): _id
+                        for _id, doc in self._docs.items()
+                    }
 
     # --- indexes ----------------------------------------------------------
     def ensure_index(self, keys, unique=False):
         fields = tuple(k[0] if isinstance(k, (tuple, list)) else k for k in keys)
         name = "_".join(fields) + "_1"
         self._indexes[name] = (fields, unique)
+        if unique and fields not in self._unique_maps:
+            entries = {}
+            for _id, doc in self._docs.items():
+                entries[self._index_key(doc, fields)] = _id
+            self._unique_maps[fields] = entries
 
     def index_information(self):
         return {name: unique for name, (_, unique) in self._indexes.items()}
@@ -113,24 +130,32 @@ class Collection:
     def drop_index(self, name):
         if name not in self._indexes:
             raise KeyError(f"index not found: {name}")
-        del self._indexes[name]
+        fields, unique = self._indexes.pop(name)
+        if unique and not any(
+            f == fields and u for f, u in self._indexes.values()
+        ):
+            self._unique_maps.pop(fields, None)
 
     def _index_key(self, doc, fields):
-        flat = flatten(doc)
-        return tuple(flat.get(f) for f in fields)
+        return tuple(_hashable(_get_path(doc, f)[1]) for f in fields)
 
     def _check_unique(self, doc, ignore_id=None):
-        for fields, unique in self._indexes.values():
-            if not unique:
-                continue
+        for fields, entries in self._unique_maps.items():
+            other = entries.get(self._index_key(doc, fields))
+            if other is not None and other != ignore_id:
+                raise DuplicateKeyError(
+                    f"duplicate key on index {fields}"
+                )
+
+    def _index_add(self, doc):
+        for fields, entries in self._unique_maps.items():
+            entries[self._index_key(doc, fields)] = doc["_id"]
+
+    def _index_discard(self, doc):
+        for fields, entries in self._unique_maps.items():
             key = self._index_key(doc, fields)
-            for other_id, other in self._docs.items():
-                if other_id == ignore_id:
-                    continue
-                if self._index_key(other, fields) == key:
-                    raise DuplicateKeyError(
-                        f"duplicate key on index {fields} with value {key}"
-                    )
+            if entries.get(key) == doc["_id"]:
+                del entries[key]
 
     # --- CRUD --------------------------------------------------------------
     def insert(self, doc):
@@ -142,12 +167,21 @@ class Collection:
             raise DuplicateKeyError(f"duplicate _id {doc['_id']!r}")
         self._check_unique(doc)
         self._docs[doc["_id"]] = doc
+        self._index_add(doc)
         return doc["_id"]
+
+    def _candidates(self, query):
+        """Docs possibly matching: O(1) for point queries by _id."""
+        _id = (query or {}).get("_id")
+        if _id is not None and not isinstance(_id, dict):
+            doc = self._docs.get(_id)
+            return [doc] if doc is not None else []
+        return self._docs.values()
 
     def find(self, query=None, projection=None):
         out = []
-        for doc in self._docs.values():
-            if _matches(flatten(doc), doc, query):
+        for doc in self._candidates(query):
+            if _matches(doc, query):
                 out.append(_project(doc, projection))
         return out
 
@@ -180,13 +214,16 @@ class Collection:
 
     def update(self, query, update, many=True):
         count = 0
-        for _id, doc in list(self._docs.items()):
-            if not _matches(flatten(doc), doc, query):
+        for doc in list(self._candidates(query)):
+            if not _matches(doc, query):
                 continue
+            _id = doc["_id"]
             new_doc = self._apply_update(doc, update)
             new_doc["_id"] = _id
             self._check_unique(new_doc, ignore_id=_id)
+            self._index_discard(doc)
             self._docs[_id] = new_doc
+            self._index_add(new_doc)
             count += 1
             if not many:
                 break
@@ -194,12 +231,15 @@ class Collection:
 
     def find_one_and_update(self, query, update, return_new=True):
         """Atomic single-document compare-and-swap (the sync primitive)."""
-        for _id, doc in self._docs.items():
-            if _matches(flatten(doc), doc, query):
+        for doc in self._candidates(query):
+            if _matches(doc, query):
+                _id = doc["_id"]
                 new_doc = self._apply_update(doc, update)
                 new_doc["_id"] = _id
                 self._check_unique(new_doc, ignore_id=_id)
+                self._index_discard(doc)
                 self._docs[_id] = new_doc
+                self._index_add(new_doc)
                 return copy.deepcopy(new_doc if return_new else doc)
         return None
 
@@ -208,11 +248,10 @@ class Collection:
 
     def remove(self, query=None):
         doomed = [
-            _id
-            for _id, doc in self._docs.items()
-            if _matches(flatten(doc), doc, query)
+            doc["_id"] for doc in self._candidates(query) if _matches(doc, query)
         ]
         for _id in doomed:
+            self._index_discard(self._docs[_id])
             del self._docs[_id]
         return len(doomed)
 

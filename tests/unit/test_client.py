@@ -83,3 +83,27 @@ def test_runner_preset_smoke():
         del PRESETS["smoke"]
     assert out["trials"] == 20
     assert out["simple_regret"] is not None
+
+
+def test_client_suggest_recovers_lost_trial_despite_throttle(tmp_path):
+    """A dead worker's trial must be claimable by client.suggest even when
+    the rate-limited reservation sweep just ran (review regression)."""
+    import time
+
+    from orion_tpu.client.experiment import ExperimentClient
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.storage import create_storage
+    from orion_tpu.testing import DumbAlgo  # noqa: F401  (registers "dumbalgo")
+
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage, "lost", priors={"/x": "uniform(0, 1)"}, max_trials=1,
+        algorithms={"dumbalgo": {}},
+    ).instantiate()
+    client = ExperimentClient(exp)
+    [trial] = client.suggest(1)
+    # Worker "dies": backdate the heartbeat past the lost threshold.
+    storage.db.write("trials", {"heartbeat": time.time() - 9999}, {"_id": trial.id})
+    # max_trials=1 -> the producer cannot make a new one; only recovery works.
+    [recovered] = client.suggest(1)
+    assert recovered.id == trial.id

@@ -461,3 +461,24 @@ def test_telemetry_batched_write_and_cap(storage):
     assert len(docs) <= 50
     # The newest samples survive the prune.
     assert docs[-1]["duration"] >= 0.05
+
+
+def test_unpickling_pre_index_db_rebuilds_unique_maps(tmp_path):
+    """DB files written before the hash-index rewrite must keep loading."""
+    import pickle
+
+    from orion_tpu.storage.documents import Collection
+
+    col = Collection()
+    col.ensure_index(["name", "version"], unique=True)
+    col.insert({"name": "n", "version": 1})
+    # Simulate an old-version pickle: strip the new attribute.
+    state = dict(col.__dict__)
+    del state["_unique_maps"]
+    old = pickle.loads(pickle.dumps(col))
+    old.__dict__.clear()
+    old.__setstate__(state)
+
+    with pytest.raises(DuplicateKeyError):
+        old.insert({"name": "n", "version": 1})  # index still enforced
+    old.insert({"name": "n", "version": 2})
