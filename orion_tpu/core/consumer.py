@@ -90,6 +90,16 @@ class Consumer:
         env["ORION_TRIAL_ID"] = str(trial.id)
         env["ORION_WORKING_DIR"] = str(trial.working_dir)
         env["ORION_RESULTS_PATH"] = str(results_path)
+        # Guarantee `orion_tpu.client` is importable in the user script even
+        # when the framework runs from a source checkout (not pip-installed)
+        # and the trial's working dir is elsewhere.
+        # Appended (not prepended) so user PYTHONPATH overrides keep priority.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        existing = env.get("PYTHONPATH")
+        if not existing:
+            env["PYTHONPATH"] = pkg_root
+        elif pkg_root not in existing.split(os.pathsep):
+            env["PYTHONPATH"] = existing + os.pathsep + pkg_root
         return env
 
     def _execute_process(self, command, env, trial):

@@ -91,6 +91,7 @@ class Experiment:
     def fix_lost_trials(self):
         """Sweep reserved trials with stale heartbeats back to reservable
         (the elastic-recovery story; reference `experiment.py:217-232`)."""
+        self._last_lost_sweep = time.monotonic()
         for trial in self._storage.fetch_lost_trials(self._id, self.heartbeat):
             try:
                 self._storage.set_trial_status(trial, "interrupted", was="reserved")
@@ -106,7 +107,6 @@ class Experiment:
         interval = max(1.0, self.heartbeat / 4.0)
         if now - self._last_lost_sweep < interval:
             return
-        self._last_lost_sweep = now
         self.fix_lost_trials()
 
     def reserve_trial(self):

@@ -108,10 +108,7 @@ class Collection:
             self._unique_maps = {}
             for fields, unique in self._indexes.values():
                 if unique and fields not in self._unique_maps:
-                    self._unique_maps[fields] = {
-                        self._index_key(doc, fields): _id
-                        for _id, doc in self._docs.items()
-                    }
+                    self._unique_maps[fields] = self._build_unique_map(fields)
 
     # --- indexes ----------------------------------------------------------
     def ensure_index(self, keys, unique=False):
@@ -119,10 +116,17 @@ class Collection:
         name = "_".join(fields) + "_1"
         self._indexes[name] = (fields, unique)
         if unique and fields not in self._unique_maps:
-            entries = {}
-            for _id, doc in self._docs.items():
-                entries[self._index_key(doc, fields)] = _id
-            self._unique_maps[fields] = entries
+            self._unique_maps[fields] = self._build_unique_map(fields)
+        elif not unique and not any(
+            f == fields and u for f, u in self._indexes.values()
+        ):
+            # Redefined unique -> non-unique: stop enforcing uniqueness.
+            self._unique_maps.pop(fields, None)
+
+    def _build_unique_map(self, fields):
+        return {
+            self._index_key(doc, fields): _id for _id, doc in self._docs.items()
+        }
 
     def index_information(self):
         return {name: unique for name, (_, unique) in self._indexes.items()}

@@ -79,6 +79,15 @@ def test_db_unique_index():
     db.write("c", {"name": "n", "version": 2})
 
 
+def test_db_index_redefined_non_unique_stops_enforcing():
+    db = MemoryDB()
+    db.ensure_index("c", ["name"], unique=True)
+    db.ensure_index("c", ["name"], unique=False)
+    db.write("c", {"name": "n"})
+    db.write("c", {"name": "n"})  # must not raise
+    assert db.count("c", {"name": "n"}) == 2
+
+
 def test_db_read_and_write_atomic_semantics():
     db = MemoryDB()
     db.write("c", {"a": 1, "st": "new"})
