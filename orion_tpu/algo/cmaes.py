@@ -1,0 +1,233 @@
+"""CMA-ES on device — covariance matrix adaptation evolution strategy.
+
+No reference counterpart (Oríon v0.1.7 ships only random search + ASHA;
+its plugin docs name evolutionary algorithms as the intended extension
+family, cf. reference `docs/src/plugins/algorithms.rst`).  This is the
+TPU-native take: the search distribution N(m, sigma^2 C) lives on device,
+``suggest`` is one jitted draw of the whole q-batch (MXU matmul against the
+covariance factor), and the rank-mu/rank-1 update is one jitted step whose
+heavy op is a (d, d) eigendecomposition — all static shapes.
+
+Async contract: the canonical algorithm is generational (ask lambda points,
+tell lambda results) but the producer observes completed trials in arbitrary
+dribs.  Observations therefore accumulate in a host-side buffer; every time
+``popsize`` results are available one generation update runs on device.
+Suggestions beyond ``popsize`` per round are extra i.i.d. draws from the
+current distribution — valid, just not all used by the next update.
+"""
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from orion_tpu.algo.base import BaseAlgorithm, algo_registry
+from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _cma_sample(key, state, num):
+    """Draw ``num`` candidates from N(m, sigma^2 C), reflected into [0,1]^d.
+
+    C's eigendecomposition is part of the carried state (refreshed by the
+    update step), so sampling is just z @ (B sqrt(D))^T — one matmul.
+    """
+    m, sigma, _C, B, D, _pc, _ps, _gen = state
+    d = m.shape[0]
+    z = jax.random.normal(key, (num, d))
+    x = m[None, :] + sigma * (z * D[None, :]) @ B.T
+    return reflect_unit(x)
+
+
+def _init_state(d, sigma0):
+    return (
+        jnp.full((d,), 0.5, jnp.float32),     # m: mean
+        jnp.float32(sigma0),                  # sigma: global step size
+        jnp.eye(d, dtype=jnp.float32),        # C: covariance
+        jnp.eye(d, dtype=jnp.float32),        # B: eigenvectors of C
+        jnp.ones((d,), jnp.float32),          # D: sqrt eigenvalues of C
+        jnp.zeros((d,), jnp.float32),         # p_c: covariance path
+        jnp.zeros((d,), jnp.float32),         # p_sigma: step-size path
+        jnp.int32(0),                         # generation counter
+    )
+
+
+@jax.jit
+def _cma_update(state, X, y):
+    """One generation: rank by objective, shift mean, adapt paths/C/sigma.
+
+    Hansen's (mu/mu_w, lambda) update with rank-1 + rank-mu covariance
+    adaptation; lambda = X.shape[0] is static, so the strategy constants
+    fold into the compiled graph.
+    """
+    m, sigma, C, B, D, pc, ps, gen = state
+    d = m.shape[0]
+    lam = X.shape[0]
+    mu = lam // 2
+    # Recombination weights (positive half, log-linear).
+    w = jnp.log(mu + 0.5) - jnp.log(jnp.arange(1, mu + 1, dtype=jnp.float32))
+    w = w / jnp.sum(w)
+    mueff = 1.0 / jnp.sum(w**2)
+
+    # Strategy constants (Hansen 2016 tutorial defaults).
+    cs = (mueff + 2.0) / (d + mueff + 5.0)
+    ds = 1.0 + 2.0 * jnp.maximum(0.0, jnp.sqrt((mueff - 1.0) / (d + 1.0)) - 1.0) + cs
+    cc = (4.0 + mueff / d) / (d + 4.0 + 2.0 * mueff / d)
+    c1 = 2.0 / ((d + 1.3) ** 2 + mueff)
+    cmu = jnp.minimum(
+        1.0 - c1, 2.0 * (mueff - 2.0 + 1.0 / mueff) / ((d + 2.0) ** 2 + mueff)
+    )
+    chi_d = math.sqrt(d) * (1.0 - 1.0 / (4.0 * d) + 1.0 / (21.0 * d * d))
+
+    order = jnp.argsort(y)
+    X_mu = X[order[:mu]]                      # (mu, d) best points
+    m_new = w @ X_mu
+    shift = (m_new - m) / sigma               # (d,)
+
+    # C^{-1/2} from the carried eigendecomposition.
+    inv_sqrt = (B * (1.0 / D)[None, :]) @ B.T
+    ps_new = (1.0 - cs) * ps + jnp.sqrt(cs * (2.0 - cs) * mueff) * (inv_sqrt @ shift)
+    gen_new = gen + 1
+    hs = (
+        jnp.linalg.norm(ps_new)
+        / jnp.sqrt(1.0 - (1.0 - cs) ** (2.0 * gen_new.astype(jnp.float32)))
+        / chi_d
+    ) < (1.4 + 2.0 / (d + 1.0))
+    hs = hs.astype(jnp.float32)
+    pc_new = (1.0 - cc) * pc + hs * jnp.sqrt(cc * (2.0 - cc) * mueff) * shift
+
+    Y_mu = (X_mu - m[None, :]) / sigma        # (mu, d)
+    rank_mu = (Y_mu * w[:, None]).T @ Y_mu    # MXU: weighted scatter matrix
+    delta_hs = (1.0 - hs) * cc * (2.0 - cc)
+    C_new = (
+        (1.0 - c1 - cmu) * C
+        + c1 * (jnp.outer(pc_new, pc_new) + delta_hs * C)
+        + cmu * rank_mu
+    )
+    C_new = 0.5 * (C_new + C_new.T)
+
+    sigma_new = sigma * jnp.exp((cs / ds) * (jnp.linalg.norm(ps_new) / chi_d - 1.0))
+    # Keep the distribution inside sane bounds for the unit cube.
+    sigma_new = jnp.clip(sigma_new, 1e-12, 1.0)
+
+    eigval, B_new = jnp.linalg.eigh(C_new)
+    D_new = jnp.sqrt(jnp.clip(eigval, 1e-20, None))
+    return (
+        m_new,
+        sigma_new,
+        C_new,
+        B_new,
+        D_new,
+        pc_new,
+        ps_new,
+        gen_new,
+    )
+
+
+@algo_registry.register("cmaes")
+class CMAES(BaseAlgorithm):
+    """Covariance matrix adaptation evolution strategy on the unit cube.
+
+    Parameters
+    ----------
+    popsize: generation size lambda (default ``4 + floor(3 ln d)``).  An
+        update runs every time this many new observations have accumulated.
+    sigma0: initial global step size (0.3 covers the unit cube well).
+    tol_sigma: declare ``is_done`` when the step size collapses below this
+        (the distribution has converged to a point).
+    """
+
+    def __init__(self, space, seed=None, popsize=None, sigma0=0.3, tol_sigma=1e-10):
+        d = space.n_cols
+        if popsize is None:
+            popsize = 4 + int(3 * math.log(max(d, 2)))
+        popsize = max(int(popsize), 4)
+        super().__init__(
+            space, seed=seed, popsize=popsize, sigma0=sigma0, tol_sigma=tol_sigma
+        )
+        self.popsize = popsize
+        self.sigma0 = float(sigma0)
+        self.tol_sigma = float(tol_sigma)
+        self._state = _init_state(d, self.sigma0)
+        # Host-side generation buffer (async observations dribble in).
+        self._buf_x = np.zeros((0, d), dtype=np.float32)
+        self._buf_y = np.zeros((0,), dtype=np.float32)
+        # Worst finite objective ever seen — clamp baseline for inf-sentinel
+        # lies; the generation buffer is transient so it can't serve as the
+        # history the way sibling algos' full observation arrays do.
+        self._worst_finite = None
+
+    # --- suggestion ---------------------------------------------------------
+    def _suggest_cube(self, num):
+        return _cma_sample(self.next_key(), self._state, int(num))
+
+    # --- observation --------------------------------------------------------
+    def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
+        history = (
+            np.asarray([self._worst_finite])
+            if self._worst_finite is not None
+            else np.zeros((0,))
+        )
+        objectives = clamp_objectives(objectives, history)
+        if objectives is None:
+            return
+        batch_worst = float(np.max(objectives))
+        if self._worst_finite is None or batch_worst > self._worst_finite:
+            self._worst_finite = batch_worst
+        self._buf_x = np.concatenate(
+            [self._buf_x, np.asarray(cube, dtype=np.float32)]
+        )
+        self._buf_y = np.concatenate(
+            [self._buf_y, np.asarray(objectives, dtype=np.float32)]
+        )
+        lam = self.popsize
+        while self._buf_x.shape[0] >= lam:
+            X = jnp.asarray(self._buf_x[:lam])
+            y = jnp.asarray(self._buf_y[:lam])
+            self._state = _cma_update(self._state, X, y)
+            self._buf_x = self._buf_x[lam:]
+            self._buf_y = self._buf_y[lam:]
+
+    # --- lifecycle ----------------------------------------------------------
+    @property
+    def is_done(self):
+        return float(self._state[1]) < self.tol_sigma
+
+    # --- state --------------------------------------------------------------
+    def state_dict(self):
+        out = super().state_dict()
+        m, sigma, C, B, D, pc, ps, gen = self._state
+        out["cma"] = {
+            "m": np.asarray(m).tolist(),
+            "sigma": float(sigma),
+            "C": np.asarray(C).tolist(),
+            "pc": np.asarray(pc).tolist(),
+            "ps": np.asarray(ps).tolist(),
+            "gen": int(gen),
+        }
+        out["buf_x"] = self._buf_x.tolist()
+        out["buf_y"] = self._buf_y.tolist()
+        out["worst_finite"] = self._worst_finite
+        return out
+
+    def set_state(self, state):
+        super().set_state(state)
+        cma = state["cma"]
+        d = self.space.n_cols
+        C = jnp.asarray(np.asarray(cma["C"], dtype=np.float32).reshape(d, d))
+        eigval, B = jnp.linalg.eigh(C)
+        self._state = (
+            jnp.asarray(np.asarray(cma["m"], dtype=np.float32)),
+            jnp.float32(cma["sigma"]),
+            C,
+            B,
+            jnp.sqrt(jnp.clip(eigval, 1e-20, None)),
+            jnp.asarray(np.asarray(cma["pc"], dtype=np.float32)),
+            jnp.asarray(np.asarray(cma["ps"], dtype=np.float32)),
+            jnp.int32(cma["gen"]),
+        )
+        self._buf_x = np.asarray(state["buf_x"], dtype=np.float32).reshape(-1, d)
+        self._buf_y = np.asarray(state["buf_y"], dtype=np.float32)
+        self._worst_finite = state.get("worst_finite")

@@ -38,14 +38,6 @@ class ExperimentClient:
         while len(out) < num:
             trial = self.experiment.reserve_trial()
             if trial is None:
-                # The reservation path's lost-trial sweep is rate-limited, so
-                # a trial whose worker died moments ago may be recoverable
-                # RIGHT NOW even though the throttled sweep skipped it —
-                # check before paying for production (which can burn the
-                # whole idle budget when the space is nearly exhausted).
-                self.experiment.fix_lost_trials()
-                trial = self.experiment.reserve_trial()
-            if trial is None:
                 self.producer.produce(num - len(out))
                 trial = self.experiment.reserve_trial()
             if trial is None:

@@ -99,19 +99,29 @@ class Experiment:
             except FailedUpdate:
                 pass  # another worker got there first — fine
 
-    def _maybe_fix_lost_trials(self):
-        """Rate-limited sweep for the reservation hot path: a trial cannot
-        become lost faster than the heartbeat window, so sweeping a q=4096
-        reservation burst 4096 times is pure collection-scan overhead."""
-        now = time.monotonic()
-        interval = max(1.0, self.heartbeat / 4.0)
-        if now - self._last_lost_sweep < interval:
-            return
+    def fix_lost_trials_throttled(self, interval=None):
+        """Sweep unless one already ran within ``interval`` seconds (default
+        heartbeat/4); returns True when a sweep actually ran.  Rate limiting
+        matters on the reservation hot path: a trial cannot become lost
+        faster than the heartbeat window, so sweeping a q=4096 reservation
+        burst 4096 times is pure collection-scan overhead."""
+        if interval is None:
+            interval = max(1.0, self.heartbeat / 4.0)
+        if time.monotonic() - self._last_lost_sweep < interval:
+            return False
         self.fix_lost_trials()
+        return True
 
     def reserve_trial(self):
-        self._maybe_fix_lost_trials()
+        swept = self.fix_lost_trials_throttled()
         trial = self._storage.reserve_trial(self._id)
+        if trial is None and not swept:
+            # Miss guarantee: a dead worker's trial must be recoverable on
+            # ANY reservation attempt (reference `experiment.py:217-232`),
+            # so force the sweep the throttle skipped — but never twice in
+            # the same call.
+            self.fix_lost_trials()
+            trial = self._storage.reserve_trial(self._id)
         if trial is not None:
             trial.working_dir = self.working_dir
         return trial

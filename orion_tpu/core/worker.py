@@ -24,12 +24,6 @@ def reserve_trial(experiment, producer, _depth=0):
     """Reserve a trial, producing a fresh batch when none is pending
     (reference `worker/__init__.py:24-39`)."""
     trial = experiment.reserve_trial()
-    if trial is None:
-        # The hot-path sweep is rate-limited; before paying for a production
-        # round, force one sweep in case another worker died holding a
-        # recoverable trial moments ago.
-        experiment.fix_lost_trials()
-        trial = experiment.reserve_trial()
     if trial is not None:
         return trial
     if _depth >= 10:

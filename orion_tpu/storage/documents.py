@@ -117,10 +117,10 @@ class Collection:
         self._indexes[name] = (fields, unique)
         if unique and fields not in self._unique_maps:
             self._unique_maps[fields] = self._build_unique_map(fields)
-        elif not unique and not any(
-            f == fields and u for f, u in self._indexes.values()
-        ):
+        elif not unique:
             # Redefined unique -> non-unique: stop enforcing uniqueness.
+            # (Index names are a pure function of the fields tuple, so this
+            # entry is the only one that can cover these fields.)
             self._unique_maps.pop(fields, None)
 
     def _build_unique_map(self, fields):

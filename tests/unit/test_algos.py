@@ -22,6 +22,7 @@ ALGOS = [
     {"tpe": {"n_init": 8, "n_candidates": 256}},
     {"tpu_bo": {"n_init": 8, "n_candidates": 256, "fit_steps": 15}},
     {"grid_search": {"n_values": 8}},
+    {"cmaes": {"popsize": 8}},
 ]
 
 
@@ -203,3 +204,64 @@ def test_mixed_lenet_preset_converges_small():
     out = run_preset("mixed-lenet", seed=0, max_trials=48, batch_size=16)
     assert out["trials"] == 48
     assert out["simple_regret"] < 1.0  # random-ish is ~2-3; BO gets close fast
+
+
+def test_cmaes_converges_on_sphere():
+    space = build_space({f"x{i}": "uniform(0, 1)" for i in range(5)})
+    algo = create_algo(space, {"cmaes": {"popsize": 16}}, seed=1)
+
+    def sphere(p):
+        return sum((v - 0.4) ** 2 for v in p.values())
+
+    best = np.inf
+    for _ in range(25):
+        params = algo.suggest(16)
+        ys = [sphere(p) for p in params]
+        best = min(best, min(ys))
+        algo.observe(params, [{"objective": y} for y in ys])
+    assert best < 1e-3
+    # The distribution must have contracted toward the optimum.
+    assert float(algo._state[1]) < algo.sigma0
+
+
+def test_cmaes_update_fires_per_generation():
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"cmaes": {"popsize": 8}}, seed=0)
+    params = algo.suggest(5)
+    algo.observe(params, [{"objective": 0.1} for _ in params])
+    assert int(algo._state[-1]) == 0  # 5 < popsize: buffered, no update
+    params = algo.suggest(5)
+    algo.observe(params, [{"objective": 0.2} for _ in params])
+    assert int(algo._state[-1]) == 1  # 10 >= 8: one generation consumed
+    assert algo._buf_x.shape[0] == 2  # remainder carried over
+
+
+def test_cmaes_state_roundtrip_resumes_identically():
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    a = create_algo(space, {"cmaes": {"popsize": 8}}, seed=5)
+    params = a.suggest(8)
+    a.observe(params, [{"objective": (p["a"] - 0.5) ** 2} for p in params])
+    state = a.state_dict()
+
+    b = create_algo(space, {"cmaes": {"popsize": 8}}, seed=5)
+    b.set_state(state)
+    pa, pb = a.suggest(4), b.suggest(4)
+    assert [tuple(p.values()) for p in pa] == [tuple(p.values()) for p in pb]
+
+
+def test_cmaes_mixed_space():
+    space = build_space(
+        {
+            "lr": "loguniform(1e-4, 1e-1)",
+            "units": "uniform(16, 256, discrete=True)",
+            "act": "choices(['relu', 'tanh', 'gelu'])",
+        }
+    )
+    algo = create_algo(space, {"cmaes": {"popsize": 8}}, seed=2)
+    params = algo.suggest(8)
+    for p in params:
+        assert 1e-4 <= p["lr"] <= 1e-1
+        assert isinstance(p["units"], int)
+        assert p["act"] in ("relu", "tanh", "gelu")
+    algo.observe(params, [{"objective": float(i)} for i in range(8)])
+    assert algo.n_observed == 8
