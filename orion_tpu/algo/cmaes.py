@@ -149,7 +149,9 @@ class CMAES(BaseAlgorithm):
         )
         self.popsize = popsize
         self.sigma0 = float(sigma0)
-        self.tol_sigma = float(tol_sigma)
+        # The update step clips sigma to >= 1e-12, so a tolerance below that
+        # could never fire; clamp instead of silently dead-ending is_done.
+        self.tol_sigma = max(float(tol_sigma), 1e-12)
         self._state = _init_state(d, self.sigma0)
         # Host-side generation buffer (async observations dribble in).
         self._buf_x = np.zeros((0, d), dtype=np.float32)
@@ -193,7 +195,7 @@ class CMAES(BaseAlgorithm):
     # --- lifecycle ----------------------------------------------------------
     @property
     def is_done(self):
-        return float(self._state[1]) < self.tol_sigma
+        return float(self._state[1]) <= self.tol_sigma
 
     # --- state --------------------------------------------------------------
     def state_dict(self):

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from orion_tpu.algo.gp.gp import posterior_norm
-from orion_tpu.algo.gp.kernels import kernel_matrix
+from orion_tpu.algo.gp.kernels import cross_kernel_matrix
 
 _SQRT2 = 1.4142135623730951
 
@@ -128,10 +128,10 @@ def joint_thompson(key, state, candidates, q, kind="matern52"):
     inv_ls = jnp.exp(-state.hypers.log_lengthscales)
     amp = jnp.exp(state.hypers.log_amplitude)
     xq = candidates.astype(jnp.float32)
-    kqx = kernel_matrix(kind, xq, state.x, inv_ls, amp) * state.mask[None, :]
+    kqx = cross_kernel_matrix(kind, xq, state.x, inv_ls, amp) * state.mask[None, :]
     mean = kqx @ state.alpha
     v = jax.scipy.linalg.solve_triangular(state.chol, kqx.T, lower=True)
-    kqq = kernel_matrix(kind, xq, xq, inv_ls, amp)
+    kqq = cross_kernel_matrix(kind, xq, xq, inv_ls, amp)
     cov = kqq - v.T @ v
     cov = cov + jnp.eye(cov.shape[0], dtype=cov.dtype) * 1e-5
     chol = jnp.linalg.cholesky(cov)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from orion_tpu.algo.gp.kernels import kernel_matrix
+from orion_tpu.algo.gp.kernels import cross_kernel_matrix, kernel_matrix
 
 JITTER = 1e-5
 
@@ -131,7 +131,7 @@ def posterior(state, xq, kind="matern52"):
     linear algebra: one (m, n) kernel matmul + one triangular solve."""
     inv_ls = jnp.exp(-state.hypers.log_lengthscales)
     amp = jnp.exp(state.hypers.log_amplitude)
-    kqx = kernel_matrix(kind, xq.astype(jnp.float32), state.x, inv_ls, amp)
+    kqx = cross_kernel_matrix(kind, xq.astype(jnp.float32), state.x, inv_ls, amp)
     kqx = kqx * state.mask[None, :]
     mean_norm = kqx @ state.alpha
     v = jax.scipy.linalg.solve_triangular(state.chol, kqx.T, lower=True)
@@ -145,7 +145,7 @@ def posterior_norm(state, xq, kind="matern52"):
     """Predictive mean/std in normalized target units (for acquisitions)."""
     inv_ls = jnp.exp(-state.hypers.log_lengthscales)
     amp = jnp.exp(state.hypers.log_amplitude)
-    kqx = kernel_matrix(kind, xq.astype(jnp.float32), state.x, inv_ls, amp)
+    kqx = cross_kernel_matrix(kind, xq.astype(jnp.float32), state.x, inv_ls, amp)
     kqx = kqx * state.mask[None, :]
     mean = kqx @ state.alpha
     v = jax.scipy.linalg.solve_triangular(state.chol, kqx.T, lower=True)

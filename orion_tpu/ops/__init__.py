@@ -1,0 +1,12 @@
+"""TPU kernels (Pallas) for the framework's hot ops.
+
+The compute path is JAX/XLA throughout; these kernels cover the spots where
+explicit fusion beats what the compiler schedules — currently the
+candidate-scoring cross-gram (`fused_gram`), which fuses the distance matmul
+with the Matern/RBF epilogue so the (m, n) intermediate never round-trips
+through HBM.
+"""
+
+from orion_tpu.ops.gram import fused_gram, pallas_available
+
+__all__ = ["fused_gram", "pallas_available"]

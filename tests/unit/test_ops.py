@@ -1,0 +1,68 @@
+"""Pallas fused-gram kernel: numerical parity with the XLA path.
+
+Runs in pallas interpret mode (the CPU test mesh has no Mosaic); the real
+lowering is exercised on hardware by the bench and by `pallas_available`'s
+self-probe.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.algo.gp.kernels import cross_kernel_matrix, kernel_matrix
+from orion_tpu.ops.gram import fused_gram, pallas_available
+
+
+@pytest.mark.parametrize("kind", ["matern52", "rbf"])
+@pytest.mark.parametrize(
+    "m,n,d",
+    [
+        (300, 70, 6),    # ragged: every axis off the tile grid
+        (256, 256, 4),   # exact tiles
+        (513, 129, 130), # just past tile boundaries incl. feature axis
+    ],
+)
+def test_fused_gram_matches_xla(kind, m, n, d):
+    rng = np.random.default_rng(0)
+    xa = jnp.asarray(rng.uniform(size=(m, d)), jnp.float32)
+    xb = jnp.asarray(rng.uniform(size=(n, d)), jnp.float32)
+    ils = jnp.asarray(rng.uniform(0.5, 3.0, size=(d,)), jnp.float32)
+    amp = jnp.asarray(1.7, jnp.float32)
+    ref = kernel_matrix(kind, xa, xb, ils, amp)
+    got = fused_gram(xa, xb, ils, amp, kind=kind, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_gram_diagonal_is_amplitude():
+    """k(x, x) must equal the amplitude exactly-ish — the cancellation bug
+    the full-precision cross matmul exists to prevent."""
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.uniform(size=(64, 8)), jnp.float32)
+    ils = jnp.asarray(rng.uniform(0.5, 3.0, size=(8,)), jnp.float32)
+    amp = jnp.asarray(2.5, jnp.float32)
+    g = fused_gram(x, x, ils, amp, kind="matern52", interpret=True)
+    np.testing.assert_allclose(np.asarray(jnp.diagonal(g)), 2.5, atol=1e-4)
+
+
+def test_cross_kernel_matrix_small_shapes_stay_on_xla():
+    """Below the crossover the dispatcher must not pay pallas overhead —
+    and on the CPU test mesh pallas_available() is False anyway, so the
+    result must be identical to the plain path."""
+    rng = np.random.default_rng(2)
+    xa = jnp.asarray(rng.uniform(size=(32, 3)), jnp.float32)
+    xb = jnp.asarray(rng.uniform(size=(16, 3)), jnp.float32)
+    ils = jnp.ones((3,), jnp.float32)
+    amp = jnp.asarray(1.0, jnp.float32)
+    out = cross_kernel_matrix("matern52", xa, xb, ils, amp)
+    ref = kernel_matrix("matern52", xa, xb, ils, amp)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_pallas_available_env_override(monkeypatch):
+    pallas_available.cache_clear()
+    monkeypatch.setenv("ORION_TPU_PALLAS", "0")
+    assert pallas_available() is False
+    pallas_available.cache_clear()
+    monkeypatch.setenv("ORION_TPU_PALLAS", "1")
+    assert pallas_available() is True
+    pallas_available.cache_clear()

@@ -244,6 +244,17 @@ def test_experiment_fix_lost_trials(experiment):
     assert recovered.status == "reserved"
 
 
+def test_lost_sweep_is_throttled_on_the_hit_path(experiment):
+    """Successful reservations must not scan for lost trials every call —
+    that's the q-batch burst cost fix_lost_trials_throttled exists for."""
+    producer = Producer(experiment)
+    producer.update()
+    producer.produce(1)
+    assert experiment.reserve_trial() is not None
+    # Back-to-back within the throttle window: the sweep must be skipped.
+    assert experiment.fix_lost_trials_throttled() is False
+
+
 def test_experiment_creation_race_resolves(tmp_path):
     storage = create_storage({"type": "memory"})
     e1 = build_experiment(storage, "race", priors={"/x": "uniform(0, 1)"})
