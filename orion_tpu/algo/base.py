@@ -162,6 +162,7 @@ _BUILTIN_MODULES = (
     "random_search",
     "asha",
     "asha_bo",
+    "bohb",
     "cmaes",
     "hyperband",
     "grid_search",

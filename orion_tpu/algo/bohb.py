@@ -1,0 +1,142 @@
+"""BOHB — per-budget TPE model under Hyperband bracket scheduling.
+
+No reference counterpart (Oríon v0.1.7 ships ASHA only,
+`src/orion/algo/asha.py`); this composes the two families the framework
+already has the TPU machinery for: Hyperband's bracket/rung host logic and
+TPE's jitted KDE-ratio suggestion (`orion_tpu.algo.tpe._tpe_suggest` — one
+(m, n) pairwise-kernel matmul per density).  Classic recipe (Falkner et al.
+2018): keep observations per budget tier, model with the HIGHEST tier that
+has enough points (high-fidelity data is scarce but trustworthy), fall back
+to random until any tier qualifies.
+"""
+
+import numpy as np
+
+from orion_tpu.algo.base import algo_registry
+from orion_tpu.algo.hyperband import Hyperband
+from orion_tpu.algo.sampling import clamp_objectives
+from orion_tpu.algo.tpe import _tpe_suggest, good_bad_split  # shared TPE core
+
+import jax.numpy as jnp
+
+
+@algo_registry.register("bohb")
+class BOHB(Hyperband):
+    """Hyperband scheduling + TPE sampling from the highest informative budget.
+
+    Parameters beyond Hyperband's: ``gamma`` (good/bad split quantile),
+    ``n_candidates`` (KDE-ratio candidate pool per suggest round), and
+    ``min_points`` (observations a budget tier needs before it can be
+    modeled; default ``dims + 2``).
+    """
+
+    def __init__(
+        self,
+        space,
+        seed=None,
+        num_rungs=None,
+        reduction_factor=None,
+        gamma=0.25,
+        n_candidates=1024,
+        min_points=None,
+    ):
+        super().__init__(
+            space, seed=seed, num_rungs=num_rungs, reduction_factor=reduction_factor
+        )
+        d = space.n_cols
+        self.gamma = float(gamma)
+        self.n_candidates = int(n_candidates)
+        self.min_points = int(min_points) if min_points is not None else d + 2
+        self._params.update(
+            gamma=self.gamma, n_candidates=self.n_candidates, min_points=self.min_points
+        )
+        # budget tier -> (x (n, d) unit-cube rows, y (n,)) observation arrays.
+        self._tier_x = {}
+        self._tier_y = {}
+
+    def __deepcopy__(self, memo):
+        """The producer deepcopies the algorithm every round for its naive
+        copy; the tier observation arrays are append-only (rebound via
+        np.concatenate, never mutated), so share them through a shallow dict
+        copy instead of duplicating O(total observations x dims) each round
+        (same discipline as asha_bo)."""
+        import copy as _copy
+
+        cls = type(self)
+        clone = cls.__new__(cls)
+        memo[id(self)] = clone
+        for key, value in self.__dict__.items():
+            if key in ("_tier_x", "_tier_y"):
+                setattr(clone, key, dict(value))
+            elif key == "space":
+                setattr(clone, key, value)
+            else:
+                setattr(clone, key, _copy.deepcopy(value, memo))
+        return clone
+
+    # --- observation --------------------------------------------------------
+    def observe(self, params_list, results):
+        super().observe(params_list, results)  # rung/promotion bookkeeping
+        by_tier = {}
+        for params, result in zip(params_list, results):
+            objective = result.get("objective")
+            if objective is None:
+                continue
+            tier = int(params.get(self.fidelity_name, 1))
+            by_tier.setdefault(tier, ([], []))
+            by_tier[tier][0].append(params)
+            by_tier[tier][1].append(float(objective))
+        for tier, (valid, yvals) in by_tier.items():
+            prev_y = self._tier_y.get(tier, np.zeros((0,), dtype=np.float32))
+            y = clamp_objectives(np.asarray(yvals, dtype=np.float64), prev_y)
+            if y is None:
+                continue
+            rows = self.space.encode_flat_np(self.space.params_to_arrays(valid))
+            prev_x = self._tier_x.get(
+                tier, np.zeros((0, self.space.n_cols), dtype=np.float32)
+            )
+            self._tier_x[tier] = np.concatenate(
+                [prev_x, np.asarray(rows, dtype=np.float32)]
+            )
+            self._tier_y[tier] = np.concatenate([prev_y, y.astype(np.float32)])
+
+    # --- model-based sampling -----------------------------------------------
+    def _model_tier(self):
+        """Highest budget whose observation count can support the KDE pair."""
+        for tier in sorted(self._tier_y, reverse=True):
+            if self._tier_y[tier].shape[0] >= self.min_points:
+                return tier
+        return None
+
+    def _new_cube(self, num):
+        tier = self._model_tier()
+        if tier is None:
+            return super()._new_cube(num)
+        good, bad = good_bad_split(self._tier_x[tier], self._tier_y[tier], self.gamma)
+        return np.asarray(
+            _tpe_suggest(
+                self.next_key(),
+                jnp.asarray(good),
+                jnp.asarray(bad),
+                self.n_candidates,
+                int(num),
+            )
+        )
+
+    # --- state --------------------------------------------------------------
+    def state_dict(self):
+        out = super().state_dict()
+        out["tiers"] = {
+            str(t): {"x": self._tier_x[t].tolist(), "y": self._tier_y[t].tolist()}
+            for t in self._tier_y
+        }
+        return out
+
+    def set_state(self, state):
+        super().set_state(state)
+        d = self.space.n_cols
+        self._tier_x, self._tier_y = {}, {}
+        for key, obs in state.get("tiers", {}).items():
+            tier = int(key)
+            self._tier_x[tier] = np.asarray(obs["x"], dtype=np.float32).reshape(-1, d)
+            self._tier_y[tier] = np.asarray(obs["y"], dtype=np.float32)

@@ -42,12 +42,7 @@ class TPE(BaseAlgorithm):
         n = len(self._y)
         if n < self.n_init:
             return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
-        n_good = max(1, int(np.ceil(self.gamma * n)))
-        order = np.argsort(self._y, kind="stable")
-        good = self._x[order[:n_good]]
-        bad = self._x[order[n_good:]]
-        if len(bad) == 0:
-            bad = good
+        good, bad = good_bad_split(self._x, self._y, self.gamma)
         return _tpe_suggest(
             self.next_key(),
             jnp.asarray(good),
@@ -68,6 +63,20 @@ class TPE(BaseAlgorithm):
         self._y = np.asarray(state["y"], dtype=np.float32)
 
 
+def good_bad_split(x, y, gamma):
+    """Split observations at the gamma quantile into (good, bad) sets; the
+    bad set falls back to the good one when everything is good (shared by
+    TPE and BOHB so the split semantics cannot diverge)."""
+    n = y.shape[0]
+    n_good = max(1, int(np.ceil(gamma * n)))
+    order = np.argsort(y, kind="stable")
+    good = x[order[:n_good]]
+    bad = x[order[n_good:]]
+    if len(bad) == 0:
+        bad = good
+    return good, bad
+
+
 def _scott_bandwidth(points):
     n, d = points.shape
     std = jnp.maximum(jnp.std(points, axis=0), 1e-3)
@@ -83,6 +92,9 @@ def _log_kde(x, points, bandwidth):
 
 @partial(jax.jit, static_argnums=(3, 4))
 def _tpe_suggest(key, good, bad, n_candidates, num):
+    # top_k needs k <= pool size: q-batch requests can exceed the configured
+    # candidate pool (q=4096 presets), so grow the pool to fit.
+    n_candidates = max(n_candidates, num)
     k_pick, k_noise, k_mix = jax.random.split(key, 3)
     bw_good = _scott_bandwidth(good)
     # Candidates ~ good-KDE (pick a good point, jitter by its bandwidth),

@@ -110,7 +110,7 @@ def pallas_available():
     """
     forced = os.environ.get("ORION_TPU_PALLAS")
     if forced is not None:
-        return forced not in ("0", "false", "no")
+        return forced.strip().lower() not in ("0", "false", "no", "off", "")
     if jax.default_backend() not in ("tpu",):
         return False
     try:

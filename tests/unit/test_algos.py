@@ -265,3 +265,57 @@ def test_cmaes_mixed_space():
         assert p["act"] in ("relu", "tanh", "gelu")
     algo.observe(params, [{"objective": float(i)} for i in range(8)])
     assert algo.n_observed == 8
+
+
+def test_bohb_models_highest_informative_tier():
+    space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 9, 3)"})
+    algo = create_algo(space, {"bohb": {"min_points": 4, "n_candidates": 128}}, seed=0)
+    assert algo._model_tier() is None  # nothing observed: random fallback
+    for _ in range(30):
+        batch = algo.suggest(2)
+        if batch is None:
+            break
+        # Quadratic whose noise shrinks with budget (fidelity-correlated).
+        algo.observe(
+            batch,
+            [{"objective": (p["x"] - 0.3) ** 2 + 0.1 / p["epochs"]} for p in batch],
+        )
+        if algo.is_done:
+            break
+    tier = algo._model_tier()
+    assert tier is not None
+    # The modeled tier must be the highest one with >= min_points.
+    for higher in (t for t in algo._tier_y if t > tier):
+        assert algo._tier_y[higher].shape[0] < 4
+    # Model-based suggestions concentrate near the optimum.
+    batch = algo.suggest(8)
+    if batch is not None:
+        xs = np.asarray([p["x"] for p in batch])
+        assert np.mean(np.abs(xs - 0.3) < 0.25) >= 0.5
+
+
+def test_bohb_state_roundtrip():
+    space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 9, 3)"})
+    a = create_algo(space, {"bohb": {"min_points": 4}}, seed=3)
+    batch = a.suggest(6)
+    a.observe(batch, [{"objective": p["x"]} for p in batch])
+    state = a.state_dict()
+    b = create_algo(space, {"bohb": {"min_points": 4}}, seed=3)
+    b.set_state(state)
+    assert {t: y.tolist() for t, y in a._tier_y.items()} == {
+        t: y.tolist() for t, y in b._tier_y.items()
+    }
+    pa, pb = a.suggest(3), b.suggest(3)
+    assert [tuple(sorted(p.items())) for p in pa] == [
+        tuple(sorted(p.items())) for p in pb
+    ]
+
+
+def test_tpe_family_q_batch_larger_than_candidate_pool():
+    """Regression: top_k with k > pool crashed; the pool must grow to num."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"tpe": {"n_init": 4, "n_candidates": 16}}, seed=0)
+    params = algo.suggest(4)
+    algo.observe(params, [{"objective": quadratic(p)} for p in params])
+    big = algo.suggest(64)  # > n_candidates
+    assert len(big) == 64
