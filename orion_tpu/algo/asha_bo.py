@@ -99,23 +99,9 @@ class ASHABO(ASHA):
         self._sigma = local_sigma
         self._best_seen = np.inf
 
-    def __deepcopy__(self, memo):
-        """The producer deepcopies the algorithm every round for its naive
-        copy; share what is immutable-by-rebinding — the fitted GP state
-        (n_pad x n_pad Cholesky), the observation arrays (appends rebind via
-        np.concatenate, never mutate), and the Space — as TPUBO does."""
-        import copy as _copy
-
-        cls = type(self)
-        clone = cls.__new__(cls)
-        memo[id(self)] = clone
-        shared = ("_gp_state", "space", "_mf_x", "_mf_s", "_mf_y")
-        for key, value in self.__dict__.items():
-            if key in shared:
-                setattr(clone, key, value)
-            else:
-                setattr(clone, key, _copy.deepcopy(value, memo))
-        return clone
+    # Naive-copy sharing (base __deepcopy__): the fitted GP state
+    # (n_pad x n_pad Cholesky) and the append-only observation arrays.
+    _share_by_ref = ("space", "_gp_state", "_mf_x", "_mf_s", "_mf_y")
 
     # --- observation ---------------------------------------------------------
     def _fid_norm(self, fidelity):

@@ -31,6 +31,33 @@ class BaseAlgorithm:
 
     requires_fidelity = False
 
+    # The producer deepcopies the algorithm every round for its naive copy
+    # (lie fantasization); these class attributes let subclasses exempt
+    # fields from that copy without each reimplementing __deepcopy__:
+    # - _share_by_ref: immutable-by-rebinding values (Space, fitted GP
+    #   state, mesh handles, append-only observation arrays that are
+    #   rebound via np.concatenate, never mutated).
+    # - _share_dicts: dicts WHOSE VALUES follow that discipline but which
+    #   are themselves mutated by key assignment — shallow-copied so the
+    #   clone's inserts don't leak back.
+    _share_by_ref = ("space",)
+    _share_dicts = ()
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+
+        cls = type(self)
+        clone = cls.__new__(cls)
+        memo[id(self)] = clone
+        for key, value in self.__dict__.items():
+            if key in self._share_by_ref:
+                setattr(clone, key, value)
+            elif key in self._share_dicts:
+                setattr(clone, key, dict(value))
+            else:
+                setattr(clone, key, _copy.deepcopy(value, memo))
+        return clone
+
     def __init__(self, space, seed=None, **params):
         if not isinstance(space, Space):
             raise TypeError(f"space must be a Space, got {type(space)}")

@@ -54,25 +54,10 @@ class BOHB(Hyperband):
         self._tier_x = {}
         self._tier_y = {}
 
-    def __deepcopy__(self, memo):
-        """The producer deepcopies the algorithm every round for its naive
-        copy; the tier observation arrays are append-only (rebound via
-        np.concatenate, never mutated), so share them through a shallow dict
-        copy instead of duplicating O(total observations x dims) each round
-        (same discipline as asha_bo)."""
-        import copy as _copy
-
-        cls = type(self)
-        clone = cls.__new__(cls)
-        memo[id(self)] = clone
-        for key, value in self.__dict__.items():
-            if key in ("_tier_x", "_tier_y"):
-                setattr(clone, key, dict(value))
-            elif key == "space":
-                setattr(clone, key, value)
-            else:
-                setattr(clone, key, _copy.deepcopy(value, memo))
-        return clone
+    # Naive-copy sharing (base __deepcopy__): the per-tier observation
+    # arrays are append-only; the dicts holding them are shallow-copied so
+    # the clone's key inserts don't leak back.
+    _share_dicts = ("_tier_x", "_tier_y")
 
     # --- observation --------------------------------------------------------
     def observe(self, params_list, results):

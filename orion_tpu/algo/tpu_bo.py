@@ -104,20 +104,10 @@ class TPUBO(BaseAlgorithm):
         self._y = np.zeros((0,), dtype=np.float32)
         self._gp_state = None
 
-    def __deepcopy__(self, memo):
-        """Producer deepcopies the algorithm each round for the naive copy;
-        share the mesh handle (not copyable) and the immutable GP state."""
-        import copy as _copy
-
-        cls = type(self)
-        clone = cls.__new__(cls)
-        memo[id(self)] = clone
-        for key, value in self.__dict__.items():
-            if key in ("_mesh", "_gp_state", "space"):
-                setattr(clone, key, value)
-            else:
-                setattr(clone, key, _copy.deepcopy(value, memo))
-        return clone
+    # Naive-copy sharing (base __deepcopy__): the mesh handle is not
+    # copyable and the fitted GP state / observation buffers are
+    # immutable-by-rebinding.
+    _share_by_ref = ("space", "_mesh", "_gp_state", "_x", "_y")
 
     # --- observation --------------------------------------------------------
     def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):

@@ -108,9 +108,9 @@ def pallas_available():
 
     Override with ORION_TPU_PALLAS=1/0.
     """
-    forced = os.environ.get("ORION_TPU_PALLAS")
-    if forced is not None:
-        return forced.strip().lower() not in ("0", "false", "no", "off", "")
+    forced = os.environ.get("ORION_TPU_PALLAS", "").strip()
+    if forced:  # set-but-empty means unset: fall through to autodetection
+        return forced.lower() not in ("0", "false", "no", "off")
     if jax.default_backend() not in ("tpu",):
         return False
     try:
