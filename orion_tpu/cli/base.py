@@ -36,7 +36,9 @@ def add_experiment_args(parser, with_user_args=True):
         "--debug", action="store_true", help="use an in-memory non-persistent storage"
     )
     group.add_argument(
-        "--storage-path", default=None, help="path of the pickled storage file"
+        "--storage-path", default=None,
+        help="path of the local storage file (.sqlite/.db selects the "
+        "SQLite backend, anything else the pickled one)"
     )
     group.add_argument(
         "--manual-resolution",
@@ -53,6 +55,20 @@ def add_experiment_args(parser, with_user_args=True):
             help="user script and its arguments, with priors as name~'expr'",
         )
     return group
+
+
+def _storage_type_for_path(path):
+    """Backend for --storage-path: an EXISTING file is identified by its
+    header (a pickled DB named results.db must keep loading as pickled —
+    extension sniffing alone would hand pickle bytes to sqlite3); only new
+    files go by extension."""
+    import os
+
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            header = f.read(16)
+        return "sqlite" if header.startswith(b"SQLite format 3\x00") else "pickled"
+    return "sqlite" if path.endswith((".sqlite", ".sqlite3", ".db")) else "pickled"
 
 
 def load_cli_config(args):
@@ -80,7 +96,10 @@ def load_cli_config(args):
     if getattr(args, "debug", False):
         storage_override = {"type": "memory"}
     elif getattr(args, "storage_path", None):
-        storage_override = {"type": "pickled", "path": args.storage_path}
+        storage_override = {
+            "type": _storage_type_for_path(args.storage_path),
+            "path": args.storage_path,
+        }
     return resolve_config(file_config, cmd_config, storage_override)
 
 

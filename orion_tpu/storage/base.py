@@ -387,6 +387,11 @@ def create_storage(config=None):
     if db_type in ("pickled", "pickleddb"):
         path = config.get("path", "orion_tpu_db.pkl")
         return DocumentStorage(PickledDB(path, lock_timeout=config.get("lock_timeout", 60.0)))
+    if db_type in ("sqlite", "sqlite3"):
+        from orion_tpu.storage.sqlitedb import SQLiteDB
+
+        path = config.get("path", "orion_tpu_db.sqlite")
+        return DocumentStorage(SQLiteDB(path, timeout=config.get("lock_timeout", 60.0)))
     if db_type in ("network", "netdb"):
         from orion_tpu.storage.netdb import NetworkDB
 

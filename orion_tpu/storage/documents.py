@@ -91,6 +91,38 @@ def _project(nested_doc, projection):
     return out
 
 
+def apply_update(doc, update):
+    """Return a copy of ``doc`` with a Mongo-style update applied.
+
+    Walks dotted update keys into the nested doc directly — never
+    flatten/unflatten the whole document, which would restructure any
+    stored key that itself contains a "." (e.g. a param named "opt.lr").
+    Shared by every backend (memory/pickled/network/sqlite) so update
+    semantics cannot diverge."""
+    sets = update.get("$set") if any(k.startswith("$") for k in update) else update
+    unsets = update.get("$unset", {})
+    new_doc = copy.deepcopy(doc)
+    for key, value in (sets or {}).items():
+        parts = key.split(".")
+        node = new_doc
+        for part in parts[:-1]:
+            if not isinstance(node.get(part), dict):
+                node[part] = {}
+            node = node[part]
+        node[parts[-1]] = copy.deepcopy(value)
+    for key in unsets:
+        parts = key.split(".")
+        node = new_doc
+        for part in parts[:-1]:
+            node = node.get(part)
+            if not isinstance(node, dict):
+                node = None
+                break
+        if isinstance(node, dict):
+            node.pop(parts[-1], None)
+    return new_doc
+
+
 class Collection:
     """One named collection of documents with unique-index enforcement."""
 
@@ -189,40 +221,13 @@ class Collection:
                 out.append(_project(doc, projection))
         return out
 
-    def _apply_update(self, doc, update):
-        # Walk dotted update keys into the nested doc directly — never
-        # flatten/unflatten the whole document, which would restructure any
-        # stored key that itself contains a "." (e.g. a param named "opt.lr").
-        sets = update.get("$set") if any(k.startswith("$") for k in update) else update
-        unsets = update.get("$unset", {})
-        new_doc = copy.deepcopy(doc)
-        for key, value in (sets or {}).items():
-            parts = key.split(".")
-            node = new_doc
-            for part in parts[:-1]:
-                if not isinstance(node.get(part), dict):
-                    node[part] = {}
-                node = node[part]
-            node[parts[-1]] = copy.deepcopy(value)
-        for key in unsets:
-            parts = key.split(".")
-            node = new_doc
-            for part in parts[:-1]:
-                node = node.get(part)
-                if not isinstance(node, dict):
-                    node = None
-                    break
-            if isinstance(node, dict):
-                node.pop(parts[-1], None)
-        return new_doc
-
     def update(self, query, update, many=True):
         count = 0
         for doc in list(self._candidates(query)):
             if not _matches(doc, query):
                 continue
             _id = doc["_id"]
-            new_doc = self._apply_update(doc, update)
+            new_doc = apply_update(doc, update)
             new_doc["_id"] = _id
             self._check_unique(new_doc, ignore_id=_id)
             self._index_discard(doc)
@@ -238,7 +243,7 @@ class Collection:
         for doc in self._candidates(query):
             if _matches(doc, query):
                 _id = doc["_id"]
-                new_doc = self._apply_update(doc, update)
+                new_doc = apply_update(doc, update)
                 new_doc["_id"] = _id
                 self._check_unique(new_doc, ignore_id=_id)
                 self._index_discard(doc)

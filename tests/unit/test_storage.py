@@ -15,13 +15,16 @@ from orion_tpu.storage.base import DocumentStorage, ReadOnlyStorage
 from orion_tpu.utils.exceptions import DuplicateKeyError, FailedUpdate
 
 
-@pytest.fixture(params=["memory", "pickled", "network"])
+@pytest.fixture(params=["memory", "pickled", "sqlite", "network"])
 def storage(request, tmp_path):
     if request.param == "memory":
         yield create_storage({"type": "memory"})
         return
     if request.param == "pickled":
         yield create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+        return
+    if request.param == "sqlite":
+        yield create_storage({"type": "sqlite", "path": str(tmp_path / "db.sqlite")})
         return
     from orion_tpu.storage import DBServer
 
@@ -210,8 +213,8 @@ def test_readonly_storage_blocks_writes(storage):
 # --- multiprocess safety ---------------------------------------------------
 
 
-def _worker_reserve(path, out_queue):
-    storage = create_storage({"type": "pickled", "path": path})
+def _worker_reserve(config, out_queue):
+    storage = create_storage(config)
     claimed = []
     while True:
         trial = storage.reserve_trial("exp-id")
@@ -221,10 +224,11 @@ def _worker_reserve(path, out_queue):
     out_queue.put(claimed)
 
 
-def test_concurrent_reservation_no_double_claims(tmp_path):
+@pytest.mark.parametrize("db_type", ["pickled", "sqlite"])
+def test_concurrent_reservation_no_double_claims(tmp_path, db_type):
     """N processes hammer reserve_trial; every trial is claimed exactly once."""
-    path = str(tmp_path / "db.pkl")
-    storage = create_storage({"type": "pickled", "path": path})
+    config = {"type": db_type, "path": str(tmp_path / f"db.{db_type}")}
+    storage = create_storage(config)
     all_ids = set()
     for i in range(20):
         t = new_trial(i)
@@ -233,7 +237,7 @@ def test_concurrent_reservation_no_double_claims(tmp_path):
 
     ctx = multiprocessing.get_context("spawn")
     queue = ctx.Queue()
-    procs = [ctx.Process(target=_worker_reserve, args=(path, queue)) for _ in range(4)]
+    procs = [ctx.Process(target=_worker_reserve, args=(config, queue)) for _ in range(4)]
     for p in procs:
         p.start()
     results = [queue.get(timeout=60) for _ in procs]
@@ -491,3 +495,53 @@ def test_unpickling_pre_index_db_rebuilds_unique_maps(tmp_path):
     with pytest.raises(DuplicateKeyError):
         old.insert({"name": "n", "version": 1})  # index still enforced
     old.insert({"name": "n", "version": 2})
+
+
+def test_sqlite_persists_across_instances(tmp_path):
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    path = str(tmp_path / "db.sqlite")
+    db = SQLiteDB(path)
+    db.ensure_index("c", ["name"], unique=True)
+    db.write("c", {"name": "n", "v": [1, 2, {"deep": True}]})
+    db.close()
+
+    db2 = SQLiteDB(path)
+    (doc,) = db2.read("c", {"name": "n"})
+    assert doc["v"] == [1, 2, {"deep": True}]
+    assert db2.index_information("c") == {"name_1": True}
+    with pytest.raises(DuplicateKeyError):
+        db2.write("c", {"name": "n"})
+
+
+def test_sqlite_unique_backfill_tolerates_existing_duplicates(tmp_path):
+    """Pre-existing duplicates must not make legacy data unreadable (same
+    last-wins behavior as the memory backend); NEW duplicates still raise."""
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    db = SQLiteDB(str(tmp_path / "db.sqlite"))
+    db.write("c", {"name": "same"})
+    db.write("c", {"name": "same"})
+    db.ensure_index("c", ["name"], unique=True)
+    assert db.count("c") == 2
+    with pytest.raises(DuplicateKeyError):
+        db.write("c", {"name": "same"})
+
+
+def test_storage_path_header_sniffing(tmp_path):
+    """A pickled DB named *.db keeps loading as pickled; new *.sqlite paths
+    select the sqlite backend."""
+    from orion_tpu.cli.base import _storage_type_for_path
+
+    pkl_as_db = tmp_path / "results.db"
+    create_storage({"type": "pickled", "path": str(pkl_as_db)}).create_experiment(
+        {"name": "n", "version": 1}
+    )
+    assert _storage_type_for_path(str(pkl_as_db)) == "pickled"
+    assert _storage_type_for_path(str(tmp_path / "new.sqlite")) == "sqlite"
+    assert _storage_type_for_path(str(tmp_path / "new.pkl")) == "pickled"
+    sq = tmp_path / "real.sqlite"
+    create_storage({"type": "sqlite", "path": str(sq)}).create_experiment(
+        {"name": "n", "version": 1}
+    )
+    assert _storage_type_for_path(str(sq)) == "sqlite"
