@@ -217,6 +217,12 @@ def create_algo(space, config=None, seed=None):
     (`base.py:104-119`).  Unknown names raise with available choices listed.
     """
     _import_builtins()
+    # Every algorithm instantiation path funnels through here: turn on the
+    # persistent XLA compilation cache so repeated processes (workers,
+    # benches, tests) skip the tens-of-seconds TPU compile per jit bucket.
+    from orion_tpu.utils.jit_cache import enable_persistent_compilation_cache
+
+    enable_persistent_compilation_cache()
 
     config = config or "random"
     if isinstance(config, str):

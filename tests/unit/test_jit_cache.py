@@ -1,0 +1,60 @@
+"""Persistent-compilation-cache wiring (the cache itself is exercised on
+hardware; these cover the configuration contract)."""
+
+import jax
+
+from orion_tpu.utils.jit_cache import enable_persistent_compilation_cache
+
+
+def test_existing_jax_config_wins():
+    # conftest configures the suite's cache dir before anything else runs;
+    # enable() must honor it rather than redirect.
+    configured = jax.config.jax_compilation_cache_dir
+    assert configured
+    assert enable_persistent_compilation_cache() == configured
+
+
+def test_off_switch_and_custom_dir(monkeypatch, tmp_path):
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setenv("ORION_TPU_JIT_CACHE", "off")
+        assert enable_persistent_compilation_cache() is None
+        assert not jax.config.jax_compilation_cache_dir
+
+        custom = str(tmp_path / "cache")
+        monkeypatch.setenv("ORION_TPU_JIT_CACHE", custom)
+        assert enable_persistent_compilation_cache() == custom
+        assert jax.config.jax_compilation_cache_dir == custom
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_default_dir_under_xdg_cache(monkeypatch, tmp_path):
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.delenv("ORION_TPU_JIT_CACHE", raising=False)
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+        out = enable_persistent_compilation_cache()
+        assert out == str(tmp_path / "orion_tpu" / "jax_cache")
+        import os
+
+        assert os.path.isdir(out)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_bare_enable_value_uses_default_dir(monkeypatch, tmp_path):
+    """ORION_TPU_JIT_CACHE=1 must enable at the default location, not create
+    a directory literally named '1' (same flag convention as ORION_TPU_PALLAS)."""
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+        monkeypatch.setenv("ORION_TPU_JIT_CACHE", "1")
+        assert enable_persistent_compilation_cache() == str(
+            tmp_path / "orion_tpu" / "jax_cache"
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
