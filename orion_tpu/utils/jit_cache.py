@@ -23,9 +23,11 @@ def enable_persistent_compilation_cache():
     """Idempotent; returns the cache dir in effect (None when disabled)."""
     import jax
 
-    configured = jax.config.jax_compilation_cache_dir
+    configured = getattr(jax.config, "jax_compilation_cache_dir", None)
     if configured:  # the user (or a test harness) already chose one
         return configured
+    if not hasattr(jax.config, "jax_compilation_cache_dir"):
+        return None  # jax build without a persistent cache: nothing to do
     override = os.environ.get("ORION_TPU_JIT_CACHE", "").strip()
     if override.lower() in _DISABLE:
         return None
@@ -42,7 +44,7 @@ def enable_persistent_compilation_cache():
         # amplification outweighs the win.  Respect a user-tuned threshold
         # (only replace jax's default), and set the dir LAST so the return
         # value always matches the enabled/disabled state.
-        if jax.config.jax_persistent_cache_min_compile_time_secs == 1.0:
+        if getattr(jax.config, "jax_persistent_cache_min_compile_time_secs", None) == 1.0:
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
     except Exception as exc:  # unwritable home, read-only fs, old jax…
