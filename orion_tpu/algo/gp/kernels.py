@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 
 
-def _sq_dists(xa, xb, inv_lengthscales):
+def sq_dists(xa, xb, inv_lengthscales):
     """Squared scaled euclidean distances, matmul-dominant.
 
     The cross term MUST run at full f32 precision: TPU's default bf16 matmul
@@ -26,11 +26,11 @@ def _sq_dists(xa, xb, inv_lengthscales):
 
 
 def rbf(xa, xb, inv_lengthscales, amplitude):
-    return amplitude * jnp.exp(-0.5 * _sq_dists(xa, xb, inv_lengthscales))
+    return amplitude * jnp.exp(-0.5 * sq_dists(xa, xb, inv_lengthscales))
 
 
 def matern52(xa, xb, inv_lengthscales, amplitude):
-    r2 = _sq_dists(xa, xb, inv_lengthscales)
+    r2 = sq_dists(xa, xb, inv_lengthscales)
     # Double-where keeps d(sqrt)/d(r2) finite at r2=0 (the diagonal): without
     # it the 1/(2 sqrt(r2)) gradient is inf there and one MLL step NaNs every
     # hyperparameter.

@@ -86,17 +86,17 @@ def _scott_bandwidth(points):
 def _log_kde(x, points, bandwidth):
     """(m,) log density of a gaussian KDE.
 
-    Bandwidth-scaled squared distances via the shared `_sq_dists` expansion
-    (`gp/kernels.py:13-25`): the dominant cost becomes one (m, d) x (d, n)
+    Bandwidth-scaled squared distances via the shared `sq_dists` expansion
+    (gp kernels): the dominant cost becomes one (m, d) x (d, n)
     MXU matmul instead of materializing an (m, n, d) diff tensor in HBM.
     Inputs are centered on the KDE points first — late in a run the good
     set clusters tightly and Scott bandwidths shrink toward the 1e-3 floor,
     so un-centered scaled coordinates reach ~1e3 and the aa+bb-2ab
     cancellation would round at the same order as the true distances."""
-    from orion_tpu.algo.gp.kernels import _sq_dists
+    from orion_tpu.algo.gp.kernels import sq_dists
 
     center = jnp.mean(points, axis=0, keepdims=True)
-    log_k = -0.5 * _sq_dists(x - center, points - center, 1.0 / bandwidth)
+    log_k = -0.5 * sq_dists(x - center, points - center, 1.0 / bandwidth)
     return jax.scipy.special.logsumexp(log_k, axis=1) - jnp.log(points.shape[0])
 
 

@@ -40,3 +40,37 @@ def shard_candidates(candidates, mesh, axis_name=CANDIDATE_AXIS):
     library users bringing their OWN candidate sets; the built-in engine
     shards inside its fused jit via `candidate_sharding` instead)."""
     return jax.device_put(candidates, candidate_sharding(mesh, axis_name))
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None,
+                     local_device_ids=None):
+    """Join a multi-host cohort so one *worker* spans several hosts.
+
+    Two distinct scaling axes exist (docs/multi_node.md):
+
+    - Independent workers coordinate through shared storage over DCN — they
+      must NOT call this; each keeps its own single-host jax.
+    - ONE logical worker running on a multi-host slice calls this in every
+      process of the cohort (same arguments everywhere, standard
+      `jax.distributed` contract).  Afterwards `jax.devices()` spans all
+      hosts, `device_mesh()` builds the global mesh, and the fused suggest
+      step's candidate axis shards across the whole slice — XLA routes the
+      top-k/argmin collectives over ICI within a host and DCN between
+      hosts.  Every process must then execute the same suggest calls
+      (SPMD); the producer/storage side stays per-cohort, not per-process.
+
+    Arguments default to jax's env-based autodetection (JAX_COORDINATOR_*,
+    cloud TPU metadata); pass them explicitly elsewhere.  Idempotent.
+    """
+    if getattr(jax.distributed, "is_initialized", None) and jax.distributed.is_initialized():
+        return
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)

@@ -23,11 +23,11 @@ def enable_persistent_compilation_cache():
     """Idempotent; returns the cache dir in effect (None when disabled)."""
     import jax
 
-    configured = getattr(jax.config, "jax_compilation_cache_dir", None)
-    if configured:  # the user (or a test harness) already chose one
-        return configured
     if not hasattr(jax.config, "jax_compilation_cache_dir"):
         return None  # jax build without a persistent cache: nothing to do
+    configured = jax.config.jax_compilation_cache_dir
+    if configured:  # the user (or a test harness) already chose one
+        return configured
     override = os.environ.get("ORION_TPU_JIT_CACHE", "").strip()
     if override.lower() in _DISABLE:
         return None

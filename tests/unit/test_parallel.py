@@ -46,3 +46,55 @@ def test_graft_entry_single_chip_jit():
     fn, args = module.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (8, 4)
+
+
+def test_init_distributed_single_process_cohort():
+    """init_distributed forms a 1-process cohort and the mesh-sharded
+    suggest step runs under it.  Subprocess: jax.distributed binds global
+    state that must not leak into the suite's process."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:  # ephemeral port: parallel suites must not collide
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    code = textwrap.dedent(
+        """
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from orion_tpu.parallel import init_distributed, device_mesh
+        init_distributed(coordinator="localhost:COHORT_PORT", num_processes=1, process_id=0)
+        init_distributed(coordinator="localhost:COHORT_PORT", num_processes=1, process_id=0)  # idempotent
+        assert jax.process_count() == 1
+        assert len(jax.devices()) == 4
+        import numpy as np
+        from orion_tpu.algo.base import create_algo
+        from orion_tpu.space.dsl import build_space
+        space = build_space({f"x{i}": "uniform(0, 1)" for i in range(3)})
+        algo = create_algo(space, {"tpu_bo": {"n_init": 4, "n_candidates": 256,
+                                               "fit_steps": 5, "use_mesh": True,
+                                               "n_devices": 4}}, seed=0)
+        params = space.sample(0, n=8)
+        algo.observe(params, [{"objective": float(v)}
+                              for v in np.random.default_rng(0).normal(size=8)])
+        assert len(algo.suggest(4)) == 4
+        print("COHORT-OK")
+        """
+    ).replace("COHORT_PORT", str(port))
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["ORION_TPU_JIT_CACHE"] = "off"  # a unit test must not write ~/.cache
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "COHORT-OK" in out.stdout
