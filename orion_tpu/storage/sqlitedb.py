@@ -207,27 +207,72 @@ class SQLiteDB:
         return [json.loads(f) for (f,) in rows]
 
     # --- document plumbing -------------------------------------------------
-    def _scan_iter(self, conn, collection, _id=None):
-        """Lazily yield parsed documents (first-match paths stop early —
-        read_and_write holds the exclusive write lock while scanning, so
-        parsing the whole collection there would serialize every worker
-        behind O(n) JSON work per reservation)."""
+    @staticmethod
+    def _sql_prefilter(query):
+        """SQL WHERE fragments for the simple top-level conditions of a
+        query (equality / $in on scalar values) via json_extract, so hot
+        scans — reservation filters on status — skip Python-parsing rows
+        that cannot match.  Python `_matches` still runs afterwards; this
+        only narrows, never decides."""
+        def pushable(v):
+            if isinstance(v, bool):
+                return False  # json_extract yields 0/1, Python has True/False
+            if isinstance(v, int):
+                return -(2**63) <= v < 2**63  # sqlite INTEGER range
+            return isinstance(v, (str, float))
+
+        clauses, params = [], []
+        for key, qv in (query or {}).items():
+            if not key.isidentifier():  # dotted/odd keys: leave to _matches
+                continue
+            path = f"$.{key}"
+            if pushable(qv):
+                clauses.append("json_extract(doc, ?) = ?")
+                params.extend([path, qv])
+            elif (
+                isinstance(qv, dict)
+                and set(qv) == {"$in"}
+                and all(pushable(v) for v in qv["$in"])
+            ):
+                marks = ",".join("?" * len(qv["$in"]))
+                clauses.append(f"json_extract(doc, ?) IN ({marks})")
+                params.extend([path, *qv["$in"]])
+        return clauses, params
+
+    def _scan_iter(self, conn, collection, query=None):
+        """Lazily yield parsed documents matching the query's SQL-pushable
+        prefix (first-match paths stop early — read_and_write holds the
+        exclusive write lock while scanning, so parsing the whole
+        collection there would serialize every worker behind O(n) JSON
+        work per reservation)."""
+        _id = (query or {}).get("_id")
         if _id is not None and not isinstance(_id, dict):
             rows = conn.execute(
                 "SELECT doc FROM docs WHERE collection = ? AND id = ?",
                 (collection, _id_key(_id)),
             )
         else:
-            rows = conn.execute(
-                "SELECT doc FROM docs WHERE collection = ?", (collection,)
-            )
+            clauses, params = self._sql_prefilter(query)
+            sql = "SELECT doc FROM docs WHERE collection = ?"
+            if clauses:
+                sql += " AND " + " AND ".join(clauses)
+            try:
+                rows = conn.execute(sql, (collection, *params)).fetchall()
+            except sqlite3.OperationalError:
+                # A doc carrying a NaN/Infinity token (json.dumps emits them
+                # for non-finite objectives) breaks SQLite's json_extract on
+                # the WHOLE scan; Python json.loads accepts them, so fall
+                # back to the unfiltered scan + _matches.
+                rows = conn.execute(
+                    "SELECT doc FROM docs WHERE collection = ?", (collection,)
+                )
         for (d,) in rows:
             yield json.loads(d)
 
-    def _scan(self, conn, collection, _id=None):
+    def _scan(self, conn, collection, query=None):
         """Materialized scan — required where the loop body mutates the
         table it is scanning (write/remove)."""
-        return list(self._scan_iter(conn, collection, _id))
+        return list(self._scan_iter(conn, collection, query))
 
     def _next_id(self, conn, collection):
         conn.execute(
@@ -296,7 +341,7 @@ class SQLiteDB:
                 return self._insert(conn, collection, data)
             data = json.loads(_dumps(data))
             count = 0
-            for doc in self._scan(conn, collection, (query or {}).get("_id")):
+            for doc in self._scan(conn, collection, query):
                 if not _matches(doc, query):
                     continue
                 new_doc = apply_update(doc, data)
@@ -310,7 +355,7 @@ class SQLiteDB:
         conn = self._conn()
         return [
             _project(doc, projection)
-            for doc in self._scan_iter(conn, collection, (query or {}).get("_id"))
+            for doc in self._scan_iter(conn, collection, query)
             if _matches(doc, query)
         ]
 
@@ -318,7 +363,7 @@ class SQLiteDB:
     def read_and_write(self, collection, query, data):
         data = json.loads(_dumps(data))
         with self._txn() as conn:
-            for doc in self._scan_iter(conn, collection, (query or {}).get("_id")):
+            for doc in self._scan_iter(conn, collection, query):
                 if _matches(doc, query):
                     new_doc = apply_update(doc, data)
                     new_doc["_id"] = doc["_id"]
@@ -336,7 +381,7 @@ class SQLiteDB:
             return n
         return sum(
             1
-            for doc in self._scan_iter(conn, collection, query.get("_id"))
+            for doc in self._scan_iter(conn, collection, query)
             if _matches(doc, query)
         )
 
@@ -345,7 +390,7 @@ class SQLiteDB:
         with self._txn() as conn:
             doomed = [
                 doc
-                for doc in self._scan(conn, collection, (query or {}).get("_id"))
+                for doc in self._scan(conn, collection, query)
                 if _matches(doc, query)
             ]
             for doc in doomed:

@@ -545,3 +545,45 @@ def test_storage_path_header_sniffing(tmp_path):
         {"name": "n", "version": 1}
     )
     assert _storage_type_for_path(str(sq)) == "sqlite"
+
+
+def test_sqlite_prefilter_narrows_without_changing_semantics(tmp_path):
+    """The SQL pushdown must agree with Python _matches for every query
+    shape it claims to narrow — and leave the rest to _matches."""
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    db = SQLiteDB(str(tmp_path / "db.sqlite"))
+    db.write("c", {"status": "new", "n": 1, "meta": {"user": "a"}})
+    db.write("c", {"status": "reserved", "n": 2, "meta": {"user": "b"}})
+    db.write("c", {"status": "completed", "n": 3, "meta": {"user": "a"}})
+    # equality + $in on top-level scalars (SQL-pushable)
+    assert db.count("c", {"status": "new"}) == 1
+    assert db.count("c", {"status": {"$in": ["new", "reserved"]}}) == 2
+    assert db.count("c", {"status": {"$in": []}}) == 0
+    # dotted keys and operators stay on the Python matcher
+    assert db.count("c", {"meta.user": "a"}) == 2
+    assert db.count("c", {"n": {"$gte": 2}}) == 2
+    # mixed pushable + non-pushable
+    assert db.count("c", {"status": {"$in": ["new", "completed"]}, "meta.user": "a"}) == 2
+    # booleans must NOT be pushed (json_extract yields 0/1, Python has True/False)
+    db.write("c", {"status": "x", "flag": True})
+    assert db.count("c", {"flag": True}) == 1
+
+
+def test_sqlite_survives_nonfinite_json_and_huge_ints(tmp_path):
+    """NaN/Infinity tokens in stored docs must not brick prefiltered scans,
+    and out-of-range int query values must match nothing, not crash."""
+    import math
+
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    db = SQLiteDB(str(tmp_path / "db.sqlite"))
+    db.write("c", {"status": "completed", "objective": float("nan")})
+    db.write("c", {"status": "new", "objective": 1.0})
+    # Pushable status filter over a collection containing a NaN doc.
+    assert db.count("c", {"status": "new"}) == 1
+    docs = db.read("c", {"status": "completed"})
+    assert len(docs) == 1 and math.isnan(docs[0]["objective"])
+    # Int beyond SQLite's 64-bit range: Python semantics, no OverflowError.
+    assert db.count("c", {"objective": 2**70}) == 0
+    assert db.count("c", {"status": {"$in": [2**70, "new"]}}) == 1
