@@ -58,17 +58,10 @@ def add_experiment_args(parser, with_user_args=True):
 
 
 def _storage_type_for_path(path):
-    """Backend for --storage-path: an EXISTING file is identified by its
-    header (a pickled DB named results.db must keep loading as pickled —
-    extension sniffing alone would hand pickle bytes to sqlite3); only new
-    files go by extension."""
-    import os
+    """Backend for --storage-path (header-sniffed; see sqlite_path_selected)."""
+    from orion_tpu.storage.sqlitedb import sqlite_path_selected
 
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            header = f.read(16)
-        return "sqlite" if header.startswith(b"SQLite format 3\x00") else "pickled"
-    return "sqlite" if path.endswith((".sqlite", ".sqlite3", ".db")) else "pickled"
+    return "sqlite" if sqlite_path_selected(path) else "pickled"
 
 
 def load_cli_config(args):

@@ -4,8 +4,11 @@ Capability parity: reference `src/orion/core/io/database/mongodb.py` — the
 networked, multi-node storage backend.  The reference delegates to an
 external mongod; pymongo is not available in this image, so the framework
 ships its own wire protocol: newline-delimited JSON requests against a
-server-side :class:`~orion_tpu.storage.documents.MemoryDB`, whose per-op
-lock makes ``read_and_write`` (find-one-and-update) atomic across every
+server-side document store — a locked in-memory
+:class:`~orion_tpu.storage.documents.MemoryDB`, or in ``--persist
+x.sqlite`` mode a :class:`~orion_tpu.storage.sqlitedb.SQLiteDB` whose
+IMMEDIATE transactions serialize writers across per-thread connections.
+Either way ``read_and_write`` (find-one-and-update) is atomic across every
 connected worker — the same role mongod's atomic `find_one_and_update`
 plays in the reference (`mongodb.py:229-247`).
 
@@ -14,9 +17,10 @@ Workers on different hosts coordinate through one server:
     host A$ orion-tpu db serve --port 8765 --persist shared.pkl
     host B$ ORION_DB_TYPE=network ORION_DB_ADDRESS=hostA:8765 orion-tpu hunt ...
 
-The server optionally persists every mutation to a pickle snapshot (atomic
-tempfile + rename, same pattern as the PickledDB backend) so it can restart
-without losing the experiment.
+The server optionally persists so it can restart without losing the
+experiment: a ``--persist x.sqlite`` path backs it with the durable SQLite
+store (every mutation committed, WAL); any other path uses rate-limited
+pickle snapshots (atomic tempfile + rename, same pattern as PickledDB).
 """
 
 import json
@@ -117,7 +121,9 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class DBServer(socketserver.ThreadingTCPServer):
-    """Serve a MemoryDB over TCP; one request = one locked DB operation."""
+    """Serve a document DB over TCP; one request = one atomic DB operation
+    (MemoryDB per-op lock, or SQLiteDB transactions in x.sqlite persist
+    mode)."""
 
     allow_reuse_address = True
     daemon_threads = True
@@ -125,16 +131,27 @@ class DBServer(socketserver.ThreadingTCPServer):
     def __init__(self, host="127.0.0.1", port=0, persist=None, persist_interval=1.0):
         self.persist = persist
         self.persist_interval = persist_interval
-        self.db = MemoryDB()
         self._persist_lock = threading.Lock()
         self._dirty = threading.Event()
         self._stop_flusher = threading.Event()
         self._flusher = None
-        if persist and os.path.exists(persist):
-            with open(persist, "rb") as handle:
-                self.db = pickle.load(handle)
+        # A .sqlite/.db persist path backs the server with the SQLite store:
+        # durable per-mutation by design (WAL), so no snapshot machinery —
+        # handler threads each get their own connection (thread-local).
+        # Header-sniffed so a legacy pickle snapshot named *.db keeps
+        # loading as a snapshot.
+        from orion_tpu.storage.sqlitedb import SQLiteDB, sqlite_path_selected
+
+        self._snapshotting = bool(persist) and not sqlite_path_selected(persist)
+        if persist and not self._snapshotting:
+            self.db = SQLiteDB(persist)
+        else:
+            self.db = MemoryDB()
+            if persist and os.path.exists(persist):
+                with open(persist, "rb") as handle:
+                    self.db = pickle.load(handle)
         super().__init__((host, port), _Handler)
-        if persist:
+        if self._snapshotting:
             self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
             self._flusher.start()
 
@@ -153,7 +170,7 @@ class DBServer(socketserver.ThreadingTCPServer):
             self._flush_if_dirty()
 
     def _flush_if_dirty(self):
-        if not (self.persist and self._dirty.is_set()):
+        if not (self._snapshotting and self._dirty.is_set()):
             return
         self._dirty.clear()
         with self._persist_lock:

@@ -88,6 +88,20 @@ def _id_key(_id):
     return _dumps(_id)
 
 
+def sqlite_path_selected(path):
+    """Should ``path`` use the SQLite backend?  An EXISTING file is
+    identified by its 16-byte header (a pickle snapshot named results.db
+    must keep loading as pickled — extension sniffing alone would hand
+    pickle bytes to sqlite3); only new files go by extension.  Shared by
+    the CLI --storage-path routing and the network server's --persist."""
+    import os
+
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            return f.read(16).startswith(b"SQLite format 3\x00")
+    return path.endswith((".sqlite", ".sqlite3", ".db"))
+
+
 def _index_key(doc, fields):
     return _dumps([_get_path(doc, f)[1] for f in fields])
 
@@ -251,23 +265,30 @@ class SQLiteDB:
                 "SELECT doc FROM docs WHERE collection = ? AND id = ?",
                 (collection, _id_key(_id)),
             )
-        else:
-            clauses, params = self._sql_prefilter(query)
-            sql = "SELECT doc FROM docs WHERE collection = ?"
-            if clauses:
-                sql += " AND " + " AND ".join(clauses)
-            try:
-                rows = conn.execute(sql, (collection, *params)).fetchall()
-            except sqlite3.OperationalError:
-                # A doc carrying a NaN/Infinity token (json.dumps emits them
-                # for non-finite objectives) breaks SQLite's json_extract on
-                # the WHOLE scan; Python json.loads accepts them, so fall
-                # back to the unfiltered scan + _matches.
-                rows = conn.execute(
-                    "SELECT doc FROM docs WHERE collection = ?", (collection,)
-                )
-        for (d,) in rows:
-            yield json.loads(d)
+            for (d,) in rows:
+                yield json.loads(d)
+            return
+        clauses, params = self._sql_prefilter(query)
+        sql = "SELECT doc FROM docs WHERE collection = ?"
+        if clauses:
+            sql += " AND " + " AND ".join(clauses)
+        yielded = set()
+        try:
+            for (d,) in conn.execute(sql, (collection, *params)):
+                doc = json.loads(d)
+                yielded.add(_id_key(doc.get("_id")))
+                yield doc
+        except sqlite3.OperationalError:
+            # A doc carrying a NaN/Infinity token (json.dumps emits them for
+            # non-finite objectives) breaks SQLite's json_extract mid-scan;
+            # Python json.loads accepts them, so finish with the unfiltered
+            # scan + _matches, skipping rows already yielded.
+            for (d,) in conn.execute(
+                "SELECT doc FROM docs WHERE collection = ?", (collection,)
+            ).fetchall():
+                doc = json.loads(d)
+                if _id_key(doc.get("_id")) not in yielded:
+                    yield doc
 
     def _scan(self, conn, collection, query=None):
         """Materialized scan — required where the loop body mutates the

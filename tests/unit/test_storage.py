@@ -587,3 +587,57 @@ def test_sqlite_survives_nonfinite_json_and_huge_ints(tmp_path):
     # Int beyond SQLite's 64-bit range: Python semantics, no OverflowError.
     assert db.count("c", {"objective": 2**70}) == 0
     assert db.count("c", {"status": {"$in": [2**70, "new"]}}) == 1
+
+
+def test_network_server_sqlite_backing(tmp_path):
+    """--persist x.sqlite backs the server with the durable SQLite store:
+    no snapshot thread, every mutation durable, restart keeps everything."""
+    from orion_tpu.storage import DBServer
+
+    path = str(tmp_path / "shared.sqlite")
+    server = DBServer(port=0, persist=path)
+    assert server._flusher is None  # durable by design, no snapshotting
+    host, port = server.serve_background()
+    storage = create_storage({"type": "network", "host": host, "port": port})
+    trial = new_trial(1)
+    storage.register_trial(trial)
+    assert storage.reserve_trial("exp-id").id == trial.id
+    server.shutdown()
+    server.server_close()
+
+    server2 = DBServer(port=0, persist=path)
+    host2, port2 = server2.serve_background()
+    try:
+        storage2 = create_storage({"type": "network", "host": host2, "port": port2})
+        fetched = storage2.fetch_trials(uid="exp-id")
+        assert [t.id for t in fetched] == [trial.id]
+        assert fetched[0].status == "reserved"  # mutation was durable
+    finally:
+        server2.shutdown()
+        server2.server_close()
+
+
+def test_network_server_legacy_pickle_snapshot_named_db(tmp_path):
+    """A pre-existing pickle snapshot whose path ends in .db must keep
+    loading as a snapshot (header sniffing), not crash SQLiteDB."""
+    from orion_tpu.storage import DBServer
+
+    path = str(tmp_path / "legacy.db")
+    server = DBServer(port=0, persist=str(tmp_path / "seed.pkl"))
+    server.server_close()
+    # Write a legacy pickle snapshot at the .db path.
+    import pickle
+
+    from orion_tpu.storage.documents import MemoryDB
+
+    db = MemoryDB()
+    db.write("c", {"a": 1})
+    with open(path, "wb") as f:
+        pickle.dump(db, f)
+
+    server2 = DBServer(port=0, persist=path)
+    try:
+        assert server2._snapshotting is True  # pickle mode, not sqlite
+        assert server2.db.count("c") == 1
+    finally:
+        server2.server_close()
