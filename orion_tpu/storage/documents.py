@@ -63,6 +63,20 @@ def _hashable(value):
         return repr(value)
 
 
+class _Unhashable:
+    """Sentinel bucket key for value maps: repr() is not canonical under
+    equality ([1] == [1.0] but their reprs differ), so unhashable stored
+    values all share one bucket that every narrowed scan includes."""
+
+
+def _value_map_key(value):
+    try:
+        hash(value)
+        return value
+    except TypeError:
+        return _Unhashable
+
+
 def _set_path(doc, dotted, value):
     parts = dotted.split(".")
     node = doc
@@ -130,17 +144,28 @@ class Collection:
         self._docs = {}  # _id -> nested document
         self._indexes = {}  # name -> (tuple of fields, unique)
         self._unique_maps = {}  # fields -> {index key -> _id}; O(1) dup checks
+        # field -> {value key -> {_id: None}} for single-field indexes:
+        # narrows scans for equality/$in queries on indexed fields (the
+        # reservation hot path filters on status — a full _matches scan per
+        # reservation is O(trials^2) over a q-batch run).  Ordered dicts so
+        # candidate order stays deterministic.
+        self._value_maps = {}
         self._auto_id = 0
 
     def __setstate__(self, state):
-        # DB files pickled by versions that predate _unique_maps must keep
-        # loading: rebuild the hash indexes from the stored docs/indexes.
+        # DB files pickled by versions that predate the hash indexes must
+        # keep loading: rebuild them from the stored docs/indexes.
         self.__dict__.update(state)
         if "_unique_maps" not in self.__dict__:
             self._unique_maps = {}
             for fields, unique in self._indexes.values():
                 if unique and fields not in self._unique_maps:
                     self._unique_maps[fields] = self._build_unique_map(fields)
+        if "_value_maps" not in self.__dict__:
+            self._value_maps = {}
+            for fields, _unique in self._indexes.values():
+                if len(fields) == 1:
+                    self._rebuild_value_map(fields[0])
 
     # --- indexes ----------------------------------------------------------
     def ensure_index(self, keys, unique=False):
@@ -154,6 +179,15 @@ class Collection:
             # (Index names are a pure function of the fields tuple, so this
             # entry is the only one that can cover these fields.)
             self._unique_maps.pop(fields, None)
+        if len(fields) == 1 and fields[0] not in self._value_maps:
+            self._rebuild_value_map(fields[0])
+
+    def _rebuild_value_map(self, field):
+        entries = {}
+        for _id, doc in self._docs.items():
+            key = _value_map_key(_get_path(doc, field)[1])
+            entries.setdefault(key, {})[_id] = None
+        self._value_maps[field] = entries
 
     def _build_unique_map(self, fields):
         return {
@@ -171,6 +205,8 @@ class Collection:
             f == fields and u for f, u in self._indexes.values()
         ):
             self._unique_maps.pop(fields, None)
+        if len(fields) == 1:
+            self._value_maps.pop(fields[0], None)
 
     def _index_key(self, doc, fields):
         return tuple(_hashable(_get_path(doc, f)[1]) for f in fields)
@@ -186,12 +222,22 @@ class Collection:
     def _index_add(self, doc):
         for fields, entries in self._unique_maps.items():
             entries[self._index_key(doc, fields)] = doc["_id"]
+        for field, entries in self._value_maps.items():
+            key = _value_map_key(_get_path(doc, field)[1])
+            entries.setdefault(key, {})[doc["_id"]] = None
 
     def _index_discard(self, doc):
         for fields, entries in self._unique_maps.items():
             key = self._index_key(doc, fields)
             if entries.get(key) == doc["_id"]:
                 del entries[key]
+        for field, entries in self._value_maps.items():
+            key = _value_map_key(_get_path(doc, field)[1])
+            bucket = entries.get(key)
+            if bucket is not None:
+                bucket.pop(doc["_id"], None)
+                if not bucket:
+                    del entries[key]  # maps must not grow with history
 
     # --- CRUD --------------------------------------------------------------
     def insert(self, doc):
@@ -207,12 +253,47 @@ class Collection:
         return doc["_id"]
 
     def _candidates(self, query):
-        """Docs possibly matching: O(1) for point queries by _id."""
+        """Docs possibly matching: O(1) for point queries by _id; narrowed
+        through the value maps for equality/$in on indexed fields (every
+        candidate still passes through `_matches` — this only prunes)."""
         _id = (query or {}).get("_id")
         if _id is not None and not isinstance(_id, dict):
             doc = self._docs.get(_id)
             return [doc] if doc is not None else []
-        return self._docs.values()
+        # Pick the cheapest indexed key by bucket sizes FIRST; materialize
+        # only the winner (merging every key's buckets would copy the full
+        # per-experiment id set on each reservation — O(trials^2) again).
+        best_key = None
+        best_size = None
+        candidates = {}
+        for key, qv in (query or {}).items():
+            entries = self._value_maps.get(key)
+            if entries is None:
+                continue
+            if isinstance(qv, dict):
+                if set(qv) != {"$in"}:
+                    continue
+                values = qv["$in"]
+            else:
+                values = [qv]
+            try:
+                for v in values:
+                    hash(v)
+            except TypeError:
+                continue  # unhashable query value: repr isn't canonical
+            size = sum(len(entries.get(v, ())) for v in values) + len(
+                entries.get(_Unhashable, ())
+            )
+            if best_size is None or size < best_size:
+                best_key, best_size, candidates = key, size, (entries, values)
+        if best_key is None:
+            return self._docs.values()
+        entries, values = candidates
+        ids = {}
+        for value in values:
+            ids.update(entries.get(value, {}))
+        ids.update(entries.get(_Unhashable, {}))
+        return [self._docs[i] for i in ids if i in self._docs]
 
     def find(self, query=None, projection=None):
         out = []

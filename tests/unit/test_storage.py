@@ -641,3 +641,32 @@ def test_network_server_legacy_pickle_snapshot_named_db(tmp_path):
         assert server2.db.count("c") == 1
     finally:
         server2.server_close()
+
+
+def test_value_map_narrowing_only_prunes():
+    """Indexed-field candidate narrowing must never drop a matching doc:
+    unhashable values (repr not canonical under ==) and cross-type equals
+    go through the sentinel bucket / full scan."""
+    db = MemoryDB()
+    db.ensure_index("c", ["f"])
+    db.write("c", {"f": [1.0], "tag": "listy"})
+    db.write("c", {"f": "x", "tag": "str"})
+    db.write("c", {"f": True, "tag": "bool"})
+    # Unhashable stored value must be found via equality ([1] == [1.0]).
+    assert db.read("c", {"f": [1]})[0]["tag"] == "listy"
+    # Cross-type equality: True == 1 in Python/Mongo semantics.
+    assert db.read("c", {"f": 1})[0]["tag"] == "bool"
+    # $in mixing hashable and unhashable query values.
+    assert {d["tag"] for d in db.read("c", {"f": {"$in": [[1], "x"]}})} == {
+        "listy", "str",
+    }
+
+
+def test_value_map_buckets_do_not_grow_with_history():
+    db = MemoryDB()
+    db.ensure_index("c", ["status"])
+    for i in range(50):
+        db.write("c", {"_id": i, "status": f"s{i}"})
+    db.remove("c", {})
+    col = db._col("c")
+    assert col._value_maps["status"] == {}
