@@ -43,6 +43,21 @@ def add_subparser(subparsers):
     )
     serve_p.set_defaults(func=main_serve)
 
+    copy_p = sub.add_parser(
+        "copy",
+        help="copy every experiment/trial between storage backends "
+        "(e.g. migrate a pickled file to sqlite or to a network server)",
+    )
+    copy_p.add_argument(
+        "--src", required=True,
+        help="source storage: a DB file path, or host:port of a network server",
+    )
+    copy_p.add_argument(
+        "--dst", required=True,
+        help="destination storage: a DB file path, or host:port (created/merged)",
+    )
+    copy_p.set_defaults(func=main_copy)
+
     test_p = sub.add_parser("test", help="run staged storage checks")
     _common(test_p)
     test_p.set_defaults(func=main_test)
@@ -59,6 +74,63 @@ def _common(parser):
     parser.add_argument("-c", "--config", metavar="path", default=None)
     parser.add_argument("--storage-path", default=None)
     parser.add_argument("--debug", action="store_true")
+
+
+def _copy_spec_to_config(spec):
+    """``host:port`` (no path separators, numeric port) selects the network
+    driver; anything else is a DB file path routed by header/extension
+    (same routing as --storage-path)."""
+    if ":" in spec and os.sep not in spec and not os.path.exists(spec):
+        host, _, port = spec.rpartition(":")
+        if port.isdigit():
+            return {"type": "network", "host": host, "port": int(port)}
+    from orion_tpu.cli.base import _storage_type_for_path
+
+    return {"type": _storage_type_for_path(spec), "path": spec}
+
+
+_COPY_COLLECTIONS = ("experiments", "trials", "lying_trials", "telemetry")
+
+
+def main_copy(args):
+    import sys
+
+    from orion_tpu.storage.base import create_storage
+
+    src = create_storage(_copy_spec_to_config(args.src))
+    dst = create_storage(_copy_spec_to_config(args.dst))
+    conflicts = 0
+    for collection in _COPY_COLLECTIONS:
+        existing = {
+            doc["_id"]: doc for doc in dst.db.read(collection)
+        }
+        missing, present = [], 0
+        for doc in src.db.read(collection):
+            other = existing.get(doc["_id"])
+            if other is None:
+                missing.append(doc)
+            elif other == doc:
+                present += 1  # idempotent: re-running a copy merges
+            else:
+                # Same _id, different content: legacy auto-increment ids can
+                # collide across unrelated databases — copying the trials
+                # would cross-wire them, so refuse loudly instead.
+                conflicts += 1
+        if missing:
+            # One batched write: per-doc writes into a pickled destination
+            # would re-lock and rewrite the whole file per document.
+            dst.db.write(collection, missing)
+        print(f"{collection}: copied {len(missing)}, already present {present}")
+    if conflicts:
+        print(
+            f"ERROR: {conflicts} document(s) share an _id with DIFFERENT "
+            "content in the destination (legacy auto-increment ids from "
+            "unrelated databases?) — nothing was copied for those; run "
+            "`orion-tpu db upgrade` on both sides to content-hash ids first.",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
 
 
 def main_serve(args):

@@ -152,6 +152,15 @@ class Collection:
         self._value_maps = {}
         self._auto_id = 0
 
+    def __getstate__(self):
+        # The hash indexes are derivable from docs+indexes: dropping them
+        # keeps pickled snapshots from growing with every distinct value,
+        # at an O(n) rebuild-on-load cost (__setstate__).
+        state = self.__dict__.copy()
+        state.pop("_unique_maps", None)
+        state.pop("_value_maps", None)
+        return state
+
     def __setstate__(self, state):
         # DB files pickled by versions that predate the hash indexes must
         # keep loading: rebuild them from the stored docs/indexes.

@@ -331,3 +331,27 @@ def test_two_users_can_own_same_experiment_name(tmp_path):
     assert len(exps) == 2
     assert {e["metadata"]["user"] for e in exps} == {"alice", "bob"}
     assert len({e["_id"] for e in exps}) == 2
+
+
+def test_db_copy_between_backends(tmp_path):
+    """`db copy` migrates an experiment between backends and is idempotent."""
+    from orion_tpu.cli import main
+
+    src = str(tmp_path / "src.pkl")
+    dst = str(tmp_path / "dst.sqlite")
+    assert main([
+        "hunt", "-n", "copy-exp", "--storage-path", src, "--max-trials", "3",
+        "--working-dir", str(tmp_path / "w"), BLACK_BOX, "-x~uniform(0,1)",
+    ]) == 0
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 0
+    # The copied experiment is fully usable from the new backend.
+    assert main(["status", "--storage-path", dst]) == 0
+    from orion_tpu.storage import create_storage
+
+    out = create_storage({"type": "sqlite", "path": dst})
+    exps = out.fetch_experiments({"name": "copy-exp"})
+    assert len(exps) == 1
+    assert len(out.fetch_trials(uid=exps[0]["_id"])) == 3
+    # Idempotent re-copy: nothing duplicated.
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 0
+    assert len(out.fetch_trials(uid=exps[0]["_id"])) == 3
