@@ -24,9 +24,11 @@ def add_subparser(subparsers):
 
     setup_p = sub.add_parser("setup", help="write the user configuration file")
     setup_p.add_argument(
-        "--storage-type", default="pickled", choices=["pickled", "memory", "network"]
+        "--storage-type",
+        default="pickled",
+        choices=["pickled", "sqlite", "memory", "network"],
     )
-    setup_p.add_argument("--path", default=None, help="pickled DB file path")
+    setup_p.add_argument("--path", default=None, help="DB file path (pickled/sqlite)")
     setup_p.add_argument("--host", default="127.0.0.1", help="network DB host")
     setup_p.add_argument("--port", type=int, default=8765, help="network DB port")
     setup_p.set_defaults(func=main_setup)
@@ -99,11 +101,12 @@ def main_copy(args):
 
     src = create_storage(_copy_spec_to_config(args.src))
     dst = create_storage(_copy_spec_to_config(args.dst))
-    conflicts = 0
+    # Plan everything BEFORE writing anything: a conflicting experiment id
+    # must abort the whole copy, or its src trials (carrying experiment=id)
+    # would attach to the unrelated dst experiment.
+    plan, conflicts = [], 0
     for collection in _COPY_COLLECTIONS:
-        existing = {
-            doc["_id"]: doc for doc in dst.db.read(collection)
-        }
+        existing = {doc["_id"]: doc for doc in dst.db.read(collection)}
         missing, present = [], 0
         for doc in src.db.read(collection):
             other = existing.get(doc["_id"])
@@ -113,23 +116,24 @@ def main_copy(args):
                 present += 1  # idempotent: re-running a copy merges
             else:
                 # Same _id, different content: legacy auto-increment ids can
-                # collide across unrelated databases — copying the trials
-                # would cross-wire them, so refuse loudly instead.
+                # collide across unrelated databases.
                 conflicts += 1
+        plan.append((collection, missing, present))
+    if conflicts:
+        print(
+            f"ERROR: {conflicts} document(s) share an _id with DIFFERENT "
+            "content in the destination (legacy auto-increment ids from "
+            "unrelated databases?) — NOTHING was copied; run "
+            "`orion-tpu db upgrade` on both sides to content-hash ids first.",
+            file=sys.stderr,
+        )
+        return 1
+    for collection, missing, present in plan:
         if missing:
             # One batched write: per-doc writes into a pickled destination
             # would re-lock and rewrite the whole file per document.
             dst.db.write(collection, missing)
         print(f"{collection}: copied {len(missing)}, already present {present}")
-    if conflicts:
-        print(
-            f"ERROR: {conflicts} document(s) share an _id with DIFFERENT "
-            "content in the destination (legacy auto-increment ids from "
-            "unrelated databases?) — nothing was copied for those; run "
-            "`orion-tpu db upgrade` on both sides to content-hash ids first.",
-            file=sys.stderr,
-        )
-        return 1
     return 0
 
 
@@ -149,9 +153,10 @@ def main_setup(args):
         storage["port"] = args.port
     elif args.path:
         storage["path"] = os.path.abspath(args.path)
-    elif args.storage_type == "pickled":
+    elif args.storage_type in ("pickled", "sqlite"):
+        ext = "pkl" if args.storage_type == "pickled" else "sqlite"
         storage["path"] = os.path.join(
-            os.path.dirname(path), "orion_tpu_db.pkl"
+            os.path.dirname(path), f"orion_tpu_db.{ext}"
         )
     existing = {}
     if os.path.exists(path):

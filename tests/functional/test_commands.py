@@ -355,3 +355,23 @@ def test_db_copy_between_backends(tmp_path):
     # Idempotent re-copy: nothing duplicated.
     assert main(["db", "copy", "--src", src, "--dst", dst]) == 0
     assert len(out.fetch_trials(uid=exps[0]["_id"])) == 3
+
+
+def test_db_copy_refuses_conflicting_ids(tmp_path):
+    """Same _id, different content -> loud failure, nothing cross-wired."""
+    from orion_tpu.cli import main
+    from orion_tpu.storage import create_storage
+
+    src = str(tmp_path / "a.pkl")
+    dst = str(tmp_path / "b.pkl")
+    s = create_storage({"type": "pickled", "path": src})
+    s.db.write("experiments", {"_id": 1, "name": "left", "version": 1})
+    # Src trials that would cross-wire onto dst's unrelated experiment 1.
+    s.db.write("trials", {"_id": "t1", "experiment": 1, "status": "new"})
+    create_storage({"type": "pickled", "path": dst}).db.write(
+        "experiments", {"_id": 1, "name": "right", "version": 1}
+    )
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 1
+    out = create_storage({"type": "pickled", "path": dst})
+    assert [e["name"] for e in out.db.read("experiments")] == ["right"]
+    assert out.db.read("trials") == []  # conflict aborts the WHOLE copy
