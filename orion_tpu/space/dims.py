@@ -105,6 +105,11 @@ class Dimension:
     def cast(self, value):
         raise NotImplementedError
 
+    def cast_decoded(self, value):
+        """Cast for values coming out of the codec (subclasses may clamp
+        f32 rounding back into bounds; user-input `cast` never clamps)."""
+        return self.cast(value)
+
     def __contains__(self, value):
         raise NotImplementedError
 
@@ -229,12 +234,22 @@ class Real(Dimension):
             arr = np.round(arr * factor) / factor
         return arr
 
-    def cast_column(self, col):
-        """Vectorized scalar cast of a length-n column -> python list.
+    def cast_decoded(self, value):
+        """Cast for DECODED values only: additionally clamps to the bounds.
 
-        One numpy pass per column instead of a python-level ``cast`` call per
+        Device decodes run in f32: when a bound is not f32-representable,
+        lo + u*span at u->1 can land epsilon past the f64 bound and the
+        sampled point would fail its own space's containment check.  The
+        user-input `cast` must NOT clamp — an out-of-range insert has to
+        fail validation, not be silently moved to the bound."""
+        return np.clip(self._cast_arr(value), self.low, self.high)
+
+    def cast_column(self, col):
+        """Vectorized decoded-cast of a length-n column -> python list.
+
+        One numpy pass per column instead of a python-level cast call per
         value — this is on the q=1024 suggest hot path (arrays_to_params)."""
-        return self._cast_arr(col).tolist()
+        return self.cast_decoded(col).tolist()
 
     def __contains__(self, value):
         try:

@@ -223,7 +223,7 @@ class Space:
                     cats = dim.categories
                     columns.append([cats[int(i)] for i in col.tolist()])
             elif dim.shape:
-                columns.append([dim.cast(row) for row in col])
+                columns.append([dim.cast_decoded(row) for row in col])
             else:
                 columns.append(dim.cast_column(col))
         return [dict(zip(names, row)) for row in zip(*columns)] if names else []

@@ -95,3 +95,22 @@ def test_dsl_configuration_roundtrip(spec):
     rebuilt = build_space(space.configuration())
     assert rebuilt == space
     assert rebuilt.configuration() == space.configuration()
+
+
+def test_f32_unrepresentable_bounds_regression():
+    """Found by the fuzzer: a narrow interval at magnitude ~512 whose bounds
+    are not f32-representable — the device decode at u->1 landed epsilon
+    past the f64 bound and the sample failed its own containment check."""
+    space = build_space({"d0": "uniform(-512.3104531655339, -512.3094531655339)"})
+    for params in space.sample(123, n=32):
+        assert space.contains_point(params), params
+
+
+def test_user_cast_does_not_clamp_out_of_bounds():
+    """Insert-path cast must leave out-of-range user values OUT of bounds so
+    validation rejects them (only DECODED values are clamped)."""
+    space = build_space({"x": "uniform(0, 1)"})
+    dim = space["x"]
+    assert float(dim.cast(999.0)) == 999.0
+    assert not space.contains_point({"x": dim.cast(999.0)})
+    assert float(dim.cast_decoded(1.0000001)) == 1.0
