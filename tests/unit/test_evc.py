@@ -438,3 +438,51 @@ def test_tree_fetcher_picks_up_midrun_branches(tmp_path):
 
     out = fetcher.fetch()
     assert [x.params["/x"] for x in out] == [0.4]
+
+
+def test_branching_prompt_scripted_session(capsys):
+    """The interactive prompt (reference branching_prompt.py) resolved via a
+    scripted session: status shows pending conflicts, add/name resolve them,
+    commit exits with everything resolved."""
+    from orion_tpu.evc.branching_prompt import BranchingPrompt
+    from orion_tpu.evc.builder import ExperimentBranchBuilder
+
+    conflicts = detect_conflicts(
+        old_config(),
+        {"priors": {"/x": "uniform(0, 10)", "/y": "uniform(0, 5)"}},
+    )
+    builder = ExperimentBranchBuilder(conflicts, manual_resolution=True)
+    prompt = BranchingPrompt(builder)
+    prompt.cmdqueue = [
+        "status",
+        "add /y 2.5",
+        "name exp2",
+        "status",
+        "commit",
+    ]
+    prompt.cmdloop(intro="")
+    out = capsys.readouterr().out
+    assert "PENDING" in out  # first status: unresolved
+    assert conflicts.are_resolved
+    resolved_names = {type(c).__name__ for c in conflicts.conflicts}
+    assert "NewDimensionConflict" in resolved_names
+
+
+def test_branching_prompt_bad_input_keeps_session(capsys):
+    """A resolution error must be reported, not crash the session."""
+    from orion_tpu.evc.branching_prompt import BranchingPrompt
+    from orion_tpu.evc.builder import ExperimentBranchBuilder
+
+    conflicts = detect_conflicts(
+        old_config(), {"priors": {"/x": "uniform(0, 10)", "/y": "uniform(0, 5)"}}
+    )
+    builder = ExperimentBranchBuilder(conflicts, manual_resolution=True)
+    prompt = BranchingPrompt(builder)
+    # "add /y" with no default hits the ValueError path (the new dimension
+    # has no default to backfill parent trials with); the session must
+    # report it and stay alive for the corrected commands.
+    prompt.cmdqueue = ["add /y", "add /y 1.0", "name exp2", "commit"]
+    prompt.cmdloop(intro="")
+    out = capsys.readouterr().out
+    assert "cannot resolve" in out
+    assert conflicts.are_resolved
