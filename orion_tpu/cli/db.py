@@ -94,25 +94,71 @@ def _copy_spec_to_config(spec):
 _COPY_COLLECTIONS = ("experiments", "trials", "lying_trials", "telemetry")
 
 
+def _same_content(a, b):
+    """Content equality across backend representations: canonical JSON
+    tolerates numpy values, tuples→lists through sqlite, and non-finite
+    floats (NaN != NaN as dicts).  Legacy pickled docs may hold values JSON
+    can't express at all (bytes, sets) — fall back to plain equality then."""
+    from orion_tpu.storage.documents import dumps_canonical
+
+    try:
+        return dumps_canonical(a) == dumps_canonical(b)
+    except TypeError:
+        try:
+            return bool(a == b)
+        except Exception:  # numpy arrays make dict.__eq__ ambiguous
+            return False
+
+
+def _unique_key(doc, fields):
+    from orion_tpu.storage.documents import _get_path, index_key
+
+    try:
+        return index_key(doc, fields)
+    except TypeError:  # non-JSON value inside a unique field: rare, legacy
+        return repr([_get_path(doc, f)[1] for f in fields])
+
+
 def main_copy(args):
     import sys
 
-    from orion_tpu.storage.base import create_storage
+    from orion_tpu.storage.base import INDEX_SPECS, create_storage
+    from orion_tpu.utils.exceptions import DuplicateKeyError
 
     src = create_storage(_copy_spec_to_config(args.src))
     dst = create_storage(_copy_spec_to_config(args.dst))
+    unique_fields = {
+        collection: fields for collection, fields, unique in INDEX_SPECS if unique
+    }
     # Plan everything BEFORE writing anything: a conflicting experiment id
     # must abort the whole copy, or its src trials (carrying experiment=id)
     # would attach to the unrelated dst experiment.
     plan, conflicts = [], 0
     for collection in _COPY_COLLECTIONS:
-        existing = {doc["_id"]: doc for doc in dst.db.read(collection)}
+        fields = unique_fields.get(collection)
+        existing = {}
+        unique_seen = set()
+        for doc in dst.db.read(collection):
+            existing[doc["_id"]] = doc
+            if fields:
+                unique_seen.add(_unique_key(doc, fields))
         missing, present = [], 0
         for doc in src.db.read(collection):
             other = existing.get(doc["_id"])
             if other is None:
+                # Distinct _ids can still collide on a unique index (the same
+                # experiment name/version/user created independently on both
+                # sides, or legacy duplicates within src): the write phase
+                # would raise mid-batch, so count it as a conflict now,
+                # while nothing has been written.
+                if fields is not None:
+                    key = _unique_key(doc, fields)
+                    if key in unique_seen:
+                        conflicts += 1
+                        continue
+                    unique_seen.add(key)
                 missing.append(doc)
-            elif other == doc:
+            elif _same_content(other, doc):
                 present += 1  # idempotent: re-running a copy merges
             else:
                 # Same _id, different content: legacy auto-increment ids can
@@ -121,10 +167,12 @@ def main_copy(args):
         plan.append((collection, missing, present))
     if conflicts:
         print(
-            f"ERROR: {conflicts} document(s) share an _id with DIFFERENT "
-            "content in the destination (legacy auto-increment ids from "
-            "unrelated databases?) — NOTHING was copied; run "
-            "`orion-tpu db upgrade` on both sides to content-hash ids first.",
+            f"ERROR: {conflicts} document(s) collide with the destination "
+            "with DIFFERENT content (same _id, or experiments sharing "
+            "name/version/user) — NOTHING was copied.  For _id collisions "
+            "from legacy auto-increment ids, run `orion-tpu db upgrade` on "
+            "both sides first; for same-named experiments, bump the version "
+            "or rename one side before copying.",
             file=sys.stderr,
         )
         return 1
@@ -132,7 +180,17 @@ def main_copy(args):
         if missing:
             # One batched write: per-doc writes into a pickled destination
             # would re-lock and rewrite the whole file per document.
-            dst.db.write(collection, missing)
+            try:
+                dst.db.write(collection, missing)
+            except DuplicateKeyError as exc:
+                # Race: a dst writer created a colliding doc after planning.
+                print(
+                    f"ERROR: destination changed during the copy "
+                    f"({collection}: {exc}) — the copy is incomplete; "
+                    "re-run to merge idempotently.",
+                    file=sys.stderr,
+                )
+                return 1
         print(f"{collection}: copied {len(missing)}, already present {present}")
     return 0
 

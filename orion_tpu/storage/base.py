@@ -76,6 +76,19 @@ class BaseStorage:
         raise NotImplementedError
 
 
+# Canonical index layout; the unique specs double as the conflict oracle for
+# `orion-tpu db copy` pre-flight planning (cli/db.py).
+INDEX_SPECS = [
+    # The user is part of experiment identity (per-user namespacing):
+    # two users may own same-named experiments.
+    ("experiments", ["name", "version", "metadata.user"], True),
+    ("trials", ["experiment"], False),
+    ("trials", ["status"], False),
+    ("trials", ["experiment", "status"], False),
+    ("lying_trials", ["experiment"], False),
+]
+
+
 class DocumentStorage(BaseStorage):
     """Protocol over any AbstractDB-style document backend."""
 
@@ -95,17 +108,7 @@ class DocumentStorage(BaseStorage):
             self._db.drop_index("experiments", "name_version_1")
         except (KeyError, DatabaseError):
             pass
-        self._db.ensure_indexes(
-            [
-                # The user is part of experiment identity (per-user
-                # namespacing): two users may own same-named experiments.
-                ("experiments", ["name", "version", "metadata.user"], True),
-                ("trials", ["experiment"], False),
-                ("trials", ["status"], False),
-                ("trials", ["experiment", "status"], False),
-                ("lying_trials", ["experiment"], False),
-            ]
-        )
+        self._db.ensure_indexes(INDEX_SPECS)
 
     # --- experiments --------------------------------------------------------
     def create_experiment(self, config):

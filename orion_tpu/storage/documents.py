@@ -11,9 +11,37 @@ one of these under a cross-process file lock.
 """
 
 import copy
+import json
 import threading
 
 from orion_tpu.utils.exceptions import DuplicateKeyError
+
+
+def json_default(value):
+    """Tolerate numpy scalars/arrays in documents (params carry them)."""
+    item = getattr(value, "item", None)
+    if callable(item):
+        try:
+            return value.item()
+        except Exception:
+            pass
+    tolist = getattr(value, "tolist", None)
+    if callable(tolist):
+        return value.tolist()
+    raise TypeError(f"not JSON serializable: {type(value)}")
+
+
+def dumps_canonical(value):
+    """Canonical JSON form of a document: sorted keys, numpy tolerated.
+    Shared by the sqlite backend (row payloads, unique-index keys) and
+    `db copy` (content comparison across backend representations)."""
+    return json.dumps(value, sort_keys=True, default=json_default)
+
+
+def index_key(doc, fields):
+    """Canonical key of a document under a (possibly dotted) field tuple —
+    the key function every backend's unique-index enforcement agrees on."""
+    return dumps_canonical([_get_path(doc, f)[1] for f in fields])
 
 _OPS = {
     "$ne": lambda doc_val, qv: doc_val != qv,

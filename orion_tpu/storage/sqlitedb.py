@@ -19,7 +19,14 @@ import json
 import sqlite3
 import threading
 
-from orion_tpu.storage.documents import apply_update, _get_path, _matches, _project
+from orion_tpu.storage.documents import (
+    apply_update,
+    dumps_canonical as _dumps,
+    index_key as _index_key,
+    _get_path,
+    _matches,
+    _project,
+)
 from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
 
 
@@ -65,24 +72,6 @@ CREATE TABLE IF NOT EXISTS counters (
 """
 
 
-def _json_default(value):
-    """Tolerate numpy scalars/arrays in documents (params carry them)."""
-    item = getattr(value, "item", None)
-    if callable(item):
-        try:
-            return value.item()
-        except Exception:
-            pass
-    tolist = getattr(value, "tolist", None)
-    if callable(tolist):
-        return value.tolist()
-    raise TypeError(f"not JSON serializable: {type(value)}")
-
-
-def _dumps(value):
-    return json.dumps(value, sort_keys=True, default=_json_default)
-
-
 def _id_key(_id):
     """Canonical string form of a document id (ids are ints or strings)."""
     return _dumps(_id)
@@ -96,14 +85,13 @@ def sqlite_path_selected(path):
     the CLI --storage-path routing and the network server's --persist."""
     import os
 
-    if os.path.exists(path):
+    if os.path.exists(path) and os.path.getsize(path) > 0:
         with open(path, "rb") as f:
             return f.read(16).startswith(b"SQLite format 3\x00")
+    # Nonexistent OR empty: sqlite3.connect creates the file zero-byte before
+    # the first schema commit writes the header, so a crash in that window
+    # must not silently flip a *.sqlite path to the pickle format.
     return path.endswith((".sqlite", ".sqlite3", ".db"))
-
-
-def _index_key(doc, fields):
-    return _dumps([_get_path(doc, f)[1] for f in fields])
 
 
 class SQLiteDB:

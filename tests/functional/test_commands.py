@@ -375,3 +375,85 @@ def test_db_copy_refuses_conflicting_ids(tmp_path):
     out = create_storage({"type": "pickled", "path": dst})
     assert [e["name"] for e in out.db.read("experiments")] == ["right"]
     assert out.db.read("trials") == []  # conflict aborts the WHOLE copy
+
+
+def test_db_copy_refuses_unique_index_collision(tmp_path):
+    """Distinct _ids but same (name, version, user) — the 'same experiment
+    created independently on both sides' case — must abort during PLANNING,
+    not traceback mid-write with earlier docs already committed."""
+    from orion_tpu.cli import main
+    from orion_tpu.storage import create_storage
+
+    src = str(tmp_path / "a.pkl")
+    dst = str(tmp_path / "b.pkl")
+    config = {"name": "exp", "version": 1, "metadata": {"user": "alice"}}
+    s = create_storage({"type": "pickled", "path": src})
+    s.db.write("experiments", {"_id": "src-id", **config})
+    s.db.write("trials", {"_id": "t1", "experiment": "src-id", "status": "new"})
+    create_storage({"type": "pickled", "path": dst}).db.write(
+        "experiments", {"_id": "dst-id", **config}
+    )
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 1
+    out = create_storage({"type": "pickled", "path": dst})
+    assert [e["_id"] for e in out.db.read("experiments")] == ["dst-id"]
+    assert out.db.read("trials") == []  # nothing was copied
+
+
+def test_db_copy_idempotent_across_representations(tmp_path):
+    """Re-copying must merge even when backend representations differ:
+    numpy values in the pickled source (dict.__eq__ would raise) and
+    tuples that come back as lists through the sqlite destination."""
+    import numpy as np
+
+    from orion_tpu.cli import main
+    from orion_tpu.storage import create_storage
+
+    src = str(tmp_path / "a.pkl")
+    dst = str(tmp_path / "b.sqlite")
+    s = create_storage({"type": "pickled", "path": src})
+    s.db.write(
+        "experiments",
+        {"_id": "e1", "name": "exp", "version": 1,
+         "metadata": {"user": "u", "arr": np.arange(3), "tup": (1, 2),
+                      "nan": float("nan")}},  # NaN != NaN must not re-conflict
+    )
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 0
+    # Second run: dst already holds the JSON-normalized form.
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 0
+    out = create_storage({"type": "sqlite", "path": dst})
+    assert len(out.db.read("experiments")) == 1
+
+
+def test_db_copy_refuses_duplicates_within_source(tmp_path):
+    """Two src experiments sharing (name, version, user) under different _ids
+    (legacy databases tolerate this; index backfill is last-wins) must abort
+    during planning, not DuplicateKeyError mid-write."""
+    from orion_tpu.cli import main
+    from orion_tpu.storage import create_storage
+
+    from orion_tpu.storage.backends import PickledDB
+
+    src = str(tmp_path / "a.pkl")
+    dst = str(tmp_path / "b.pkl")
+    config = {"name": "exp", "version": 1, "metadata": {"user": "alice"}}
+    # Bypass index enforcement the way a legacy DB would: raw backend writes
+    # before any storage protocol has ensured the unique index.
+    raw = PickledDB(src)
+    raw.write("experiments", {"_id": 1, **config})
+    raw.write("experiments", {"_id": 2, **config})
+    assert main(["db", "copy", "--src", src, "--dst", dst]) == 1
+    out = create_storage({"type": "pickled", "path": dst})
+    assert out.db.read("experiments") == []  # nothing was copied
+
+
+def test_sqlite_routing_treats_empty_file_as_new(tmp_path):
+    """A zero-byte *.sqlite file (crash between connect and first schema
+    commit, or a pre-touched path) must stay on the sqlite backend."""
+    from orion_tpu.storage.sqlitedb import sqlite_path_selected
+
+    path = tmp_path / "db.sqlite"
+    path.touch()
+    assert sqlite_path_selected(str(path))
+    other = tmp_path / "db.pkl"
+    other.touch()
+    assert not sqlite_path_selected(str(other))
