@@ -54,6 +54,23 @@ class TPUBO(BaseAlgorithm):
         round resumes from the previous round's hyperparameters, so fewer
         refit steps are viable where GP fitting dominates the round.
     local_frac: fraction of candidates drawn around the current best point.
+    y_transform: "none" (default) fits the GP on raw objectives; "copula"
+        rank-Gaussianizes them first (objective ranks mapped through the
+        normal quantile function).  Monotone, so acquisition order is
+        preserved — but the GP sees a unit-scale, outlier-free target even
+        when raw objectives span orders of magnitude (Rosenbrock-class
+        landscapes), which is exactly where raw-y GPs go blind: the valley
+        floor normalizes to one flat value and every gradient signal lives
+        in the first percentile.
+    trust_region: TuRBO-style local BO (Eriksson et al. 2019).  The local
+        candidate fraction is drawn from a box around the incumbent whose
+        per-dimension side lengths follow the fitted GP lengthscales; the
+        box expands after ``tr_succ_tol`` consecutive improving rounds,
+        halves after ``tr_fail_tol`` stagnating ones, and restarts at
+        ``tr_length_init`` when it collapses below ``tr_length_min``.  This
+        is what lets the GP concentrate samples inside high-D curved
+        valleys (Rosenbrock-class landscapes) where a global-uniform +
+        fixed-sigma-ball scheme plateaus.
     n_devices: shard candidates over this many devices (None = all visible).
     """
 
@@ -70,6 +87,16 @@ class TPUBO(BaseAlgorithm):
         beta=2.0,
         local_frac=0.5,
         local_sigma=0.1,
+        y_transform="none",
+        trust_region=False,
+        tr_length_init=0.8,
+        tr_length_min=0.5**7,
+        tr_length_max=1.6,
+        tr_succ_tol=3,
+        tr_fail_tol=4,
+        tr_improve_tol=1e-3,
+        tr_local_m=256,
+        tr_perturb_dims=20,
         n_devices=None,
         use_mesh=False,
     ):
@@ -85,6 +112,16 @@ class TPUBO(BaseAlgorithm):
             beta=beta,
             local_frac=local_frac,
             local_sigma=local_sigma,
+            y_transform=y_transform,
+            trust_region=trust_region,
+            tr_length_init=tr_length_init,
+            tr_length_min=tr_length_min,
+            tr_length_max=tr_length_max,
+            tr_succ_tol=tr_succ_tol,
+            tr_fail_tol=tr_fail_tol,
+            tr_improve_tol=tr_improve_tol,
+            tr_local_m=tr_local_m,
+            tr_perturb_dims=tr_perturb_dims,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
@@ -97,12 +134,25 @@ class TPUBO(BaseAlgorithm):
         self.beta = beta
         self.local_frac = local_frac
         self.local_sigma = local_sigma
+        self.y_transform = y_transform
+        self.trust_region = trust_region
+        self.tr_length_init = tr_length_init
+        self.tr_length_min = tr_length_min
+        self.tr_length_max = tr_length_max
+        self.tr_succ_tol = tr_succ_tol
+        self.tr_fail_tol = tr_fail_tol
+        self.tr_improve_tol = tr_improve_tol
+        self.tr_local_m = tr_local_m
+        self.tr_perturb_dims = tr_perturb_dims
         self.use_mesh = use_mesh
         self._mesh = device_mesh(n_devices) if use_mesh else None
         d = space.n_cols
         self._x = np.zeros((0, d), dtype=np.float32)
         self._y = np.zeros((0,), dtype=np.float32)
         self._gp_state = None
+        self._tr_length = tr_length_init
+        self._tr_succ = 0
+        self._tr_fail = 0
 
     # Naive-copy sharing (base __deepcopy__): the mesh handle is not
     # copyable and the fitted GP state / observation buffers are
@@ -114,8 +164,33 @@ class TPUBO(BaseAlgorithm):
         objectives = clamp_objectives(objectives, self._y)
         if objectives is None:
             return
+        prev_n = self._y.shape[0]
+        prev_best = float(np.min(self._y)) if prev_n else np.inf
         self._x = np.concatenate([self._x, np.asarray(cube, dtype=np.float32)])
         self._y = np.concatenate([self._y, np.asarray(objectives, dtype=np.float32)])
+        # Trust-region bookkeeping counts MODEL rounds only: observations of
+        # the random init phase say nothing about the local model's quality.
+        if self.trust_region and prev_n >= self.n_init:
+            new_best = float(np.min(self._y))
+            # TuRBO's improvement test: a material relative gain, so noise
+            # floors don't keep an exhausted region alive forever.
+            if new_best < prev_best - self.tr_improve_tol * abs(prev_best):
+                self._tr_succ += 1
+                self._tr_fail = 0
+            else:
+                self._tr_fail += 1
+                self._tr_succ = 0
+            if self._tr_succ >= self.tr_succ_tol:
+                self._tr_length = min(2.0 * self._tr_length, self.tr_length_max)
+                self._tr_succ = 0
+            elif self._tr_fail >= self.tr_fail_tol:
+                self._tr_length /= 2.0
+                self._tr_fail = 0
+            if self._tr_length < self.tr_length_min:
+                # Collapsed region: restart wide.  History is kept — the GP
+                # still knows the landscape; only the box resets.
+                self._tr_length = self.tr_length_init
+                self._tr_succ = self._tr_fail = 0
 
     # --- suggestion ---------------------------------------------------------
     def _suggest_cube(self, num):
@@ -129,10 +204,29 @@ class TPUBO(BaseAlgorithm):
         # the same compiled step shards the candidate axis over it (SPMD
         # collectives inserted by XLA, see orion_tpu.parallel).
         best_x = self._x[int(np.argmin(self._y))]
+        x_fit, y_raw = self._x, self._y
+        if self.trust_region and self._x.shape[0] > self.tr_local_m:
+            # LOCAL GP (the TuRBO design): fit only the tr_local_m nearest
+            # observations to the incumbent.  A global fit has to average
+            # lengthscales over the whole landscape, washing out exactly the
+            # local structure the trust region is trying to exploit — and a
+            # 4x smaller buffer makes the per-round Cholesky ~64x cheaper.
+            d2 = ((self._x - best_x[None, :]) ** 2).sum(axis=1)
+            idx = np.argpartition(d2, self.tr_local_m)[: self.tr_local_m]
+            x_fit, y_raw = self._x[idx], self._y[idx]
+        y_fit = y_raw
+        if self.y_transform == "copula":
+            # Rank -> normal quantile on host: O(n log n) over a few thousand
+            # floats per round, noise next to the device dispatch.  argmin is
+            # preserved (monotone), so best_x/TR bookkeeping stay on raw y.
+            from scipy.special import ndtri
+
+            order = np.argsort(np.argsort(y_raw))
+            y_fit = ndtri((order + 0.5) / y_raw.shape[0]).astype(np.float32)
         rows, state = run_suggest_step(
             self.next_key(),
-            self._x,
-            self._y,
+            x_fit,
+            y_fit,
             best_x,
             self._gp_state,
             num,
@@ -144,6 +238,9 @@ class TPUBO(BaseAlgorithm):
             local_frac=self.local_frac,
             local_sigma=self.local_sigma,
             beta=self.beta,
+            trust_region=self.trust_region,
+            tr_length=self._tr_length,
+            tr_perturb_dims=self.tr_perturb_dims,
             mesh=self._mesh,
         )
         self._gp_state = state
@@ -154,6 +251,7 @@ class TPUBO(BaseAlgorithm):
         out = super().state_dict()
         out["x"] = self._x.tolist()
         out["y"] = self._y.tolist()
+        out["tr"] = [self._tr_length, self._tr_succ, self._tr_fail]
         return out
 
     def set_state(self, state):
@@ -162,6 +260,23 @@ class TPUBO(BaseAlgorithm):
         self._x = np.asarray(state["x"], dtype=np.float32).reshape(-1, d)
         self._y = np.asarray(state["y"], dtype=np.float32)
         self._gp_state = None  # refit (cold) on the next suggest
+        tr = state.get("tr")
+        if tr is not None:
+            self._tr_length, self._tr_succ, self._tr_fail = tr[0], int(tr[1]), int(tr[2])
+
+
+@algo_registry.register("turbo")
+class TuRBO(TPUBO):
+    """Trust-region GP-BO: :class:`TPUBO` with TuRBO candidate generation on
+    by default and a 90/10 local/global candidate split.  Same fused-jit
+    suggest step, same public API — only the candidate scheme and its
+    host-side box bookkeeping differ."""
+
+    def __init__(self, space, seed=None, **kwargs):
+        kwargs.setdefault("trust_region", True)
+        kwargs.setdefault("local_frac", 0.9)
+        kwargs.setdefault("y_transform", "copula")
+        super().__init__(space, seed=seed, **kwargs)
 
 
 @partial(jax.jit, static_argnums=(1, 2, 4))
@@ -177,6 +292,137 @@ def _make_candidates(key, n_candidates, n_dims, best_x, local_frac, local_sigma)
     global_c = jax.random.uniform(k1, (n_global, n_dims))
     local_c = best_x[None, :] + local_sigma * jax.random.normal(k2, (n_local, n_dims))
     return jnp.concatenate([global_c, reflect_unit(local_c)], axis=0)
+
+
+def _topk_cov_chol(x, y, mask, n_dims, k=64):
+    """Cholesky factor of the covariance of the k best observed points.
+
+    The elite set's spread tracks the local geometry of the descent (a
+    curved valley stretches it along the valley's direction), giving a
+    ROTATED sampling distribution that an axis-aligned trust box cannot
+    express — the same signal CMA-ES distills into its covariance, read
+    directly off the history instead of adapted generation by generation."""
+    y_sorted_idx = jnp.argsort(jnp.where(mask > 0, y, jnp.inf))
+    elite = jnp.take(x, y_sorted_idx[:k], axis=0)
+    # CMA-style log weights: best points dominate the estimate.  Padded
+    # buffer rows sort last but can still land inside the top k when fewer
+    # than k real observations exist — zero their weight or the (0,...,0)
+    # padding rows drag mu toward the origin and the covariance toward the
+    # padding geometry.
+    w = jnp.log(k + 0.5) - jnp.log(jnp.arange(1, k + 1, dtype=x.dtype))
+    w = w * jnp.take(mask, y_sorted_idx[:k])
+    w = w / jnp.maximum(jnp.sum(w), 1e-12)
+    mu = jnp.sum(elite * w[:, None], axis=0)
+    centered = elite - mu[None, :]
+    cov = (centered * w[:, None]).T @ centered
+    # Ridge: elite sets collapsed to a subspace (or duplicates) must still
+    # factorize; 1e-6 in cube units is far below any useful step.
+    chol = jnp.linalg.cholesky(cov + 1e-6 * jnp.eye(n_dims, dtype=x.dtype))
+    return chol, mu
+
+
+def _tr_box(center, tr_length, lengthscales):
+    """Trust-box bounds: per-dimension half-widths follow the GP
+    lengthscales normalized to geometric mean 1, clipped to the cube."""
+    scale = lengthscales / jnp.exp(jnp.mean(jnp.log(lengthscales)))
+    half = 0.5 * tr_length * scale
+    lb = jnp.clip(center - half, 0.0, 1.0)
+    ub = jnp.clip(center + half, 0.0, 1.0)
+    return lb, ub
+
+
+def _polish_candidates(
+    state, kernel, starts, lb, ub, n_steps=30, lr=0.02, fixed_tail_cols=0
+):
+    """Multi-start adam descent on the GP posterior mean, box-clipped every
+    step — in-jit acquisition optimization.  Random candidates locate the
+    posterior's basins; 30 gradient steps walk the floor of the basin, which
+    random sampling cannot hit in high D.  The polished points join the
+    candidate pool; acquisition still chooses the batch, so this sharpens
+    exploitation without giving up Thompson's batch diversity."""
+    import optax
+
+    def mean_of(x_free):
+        x_full = x_free
+        if fixed_tail_cols:
+            x_full = jnp.concatenate(
+                [x_free, jnp.ones((fixed_tail_cols,), x_free.dtype)]
+            )
+        m, _ = posterior_norm(state, x_full[None, :], kind=kernel)
+        return m[0]
+
+    grad_fn = jax.grad(mean_of)
+    opt = optax.adam(lr)
+
+    def run_one(x0):
+        def step(carry, _):
+            x_cur, opt_state = carry
+            g = jnp.nan_to_num(grad_fn(x_cur))
+            updates, opt_state = opt.update(g, opt_state)
+            x_cur = jnp.clip(optax.apply_updates(x_cur, updates), lb, ub)
+            return (x_cur, opt_state), None
+
+        (x_fin, _), _ = jax.lax.scan(step, (x0, opt.init(x0)), None, length=n_steps)
+        return x_fin
+
+    return jax.vmap(run_one)(starts)
+
+
+def _make_tr_candidates(
+    key, n_candidates, n_dims, center, tr_length, lengthscales, local_frac,
+    cov_chol, elite_mu, perturb_dims=20,
+):
+    """TuRBO-style candidates: the local fraction split between the trust
+    box and elite-covariance gaussian steps, the remainder global uniform
+    (restart-free exploration floor).
+
+    The box's per-dimension half-widths follow the fitted GP lengthscales
+    normalized to geometric mean 1 (long-lengthscale = flat direction = wide
+    box side), clipped to the unit cube.  Each box candidate perturbs a
+    random ~min(20, d)-dim subset of coordinates and inherits the incumbent
+    elsewhere — in high D, moving every coordinate at once almost surely
+    leaves the valley (TuRBO's perturbation mask).  The covariance source
+    samples ``center + L_elite z`` — rotated steps along the elite set's
+    principal directions (see _topk_cov_chol), which is what actually walks
+    curved valleys.  Traced on ``tr_length``/``cov_chol`` so box resizing
+    and covariance updates never recompile."""
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+    n_local = int(n_candidates * local_frac)
+    n_cov = n_local // 4
+    n_dir = n_local // 4
+    n_box = n_local - n_cov - n_dir
+    n_global = n_candidates - n_local
+    lb, ub = _tr_box(center, tr_length, lengthscales)
+    u = jax.random.uniform(k1, (n_box, n_dims))
+    box = lb[None, :] + u * (ub - lb)[None, :]
+    p_perturb = min(1.0, perturb_dims / n_dims)
+    if p_perturb < 1.0:
+        mask = jax.random.bernoulli(k2, p_perturb, (n_box, n_dims))
+        # Guarantee at least one perturbed coordinate per candidate.
+        forced = (
+            jax.nn.one_hot(
+                jax.random.randint(k3, (n_box,), 0, n_dims), n_dims
+            )
+            > 0
+        )
+        mask = jnp.where(jnp.any(mask, axis=1, keepdims=True), mask, forced)
+        box = jnp.where(mask, box, center[None, :])
+    z = jax.random.normal(k4, (n_cov, n_dims))
+    # Half unit-scale steps, half double — the elite spread lags the true
+    # local scale while the search is still descending.
+    sigma = jnp.where(jnp.arange(n_cov)[:, None] % 2 == 0, 1.0, 2.0)
+    cov_c = reflect_unit(center[None, :] + sigma * (z @ cov_chol.T))
+    # Directional extrapolation: the elite mean trails the incumbent while
+    # the search descends, so (center - mu) points ALONG the descent path —
+    # step out at assorted magnitudes with a little covariance-shaped noise
+    # (the momentum CMA-ES gets from moving its recombination mean).
+    t = jnp.abs(jax.random.normal(k5, (n_dir, 1))) * 2.0
+    zd = jax.random.normal(k6, (n_dir, n_dims))
+    dir_c = reflect_unit(
+        center[None, :] + t * (center - elite_mu)[None, :] + 0.5 * (zd @ cov_chol.T)
+    )
+    global_c = jax.random.uniform(jax.random.fold_in(k1, 1), (n_global, n_dims))
+    return jnp.concatenate([global_c, box, cov_c, dir_c], axis=0)
 
 
 def run_suggest_step(
@@ -195,6 +441,9 @@ def run_suggest_step(
     local_frac,
     local_sigma,
     beta,
+    trust_region=False,
+    tr_length=None,
+    tr_perturb_dims=20,
     fixed_tail_cols=0,
     mesh=None,
 ):
@@ -223,6 +472,9 @@ def run_suggest_step(
         jnp.asarray(mask),
         jnp.asarray(best_x),
         warm,
+        # Dynamic (traced) so success/failure box resizing never recompiles;
+        # always an array — jit caches on dtype, not value.
+        jnp.asarray(tr_length if tr_length is not None else 1.0, jnp.float32),
         q=_next_pow2(num, floor=8),
         n_candidates=n_candidates,
         kernel=kernel,
@@ -231,6 +483,8 @@ def run_suggest_step(
         local_frac=local_frac,
         local_sigma=local_sigma,
         beta=beta,
+        trust_region=trust_region,
+        tr_perturb_dims=tr_perturb_dims,
         fixed_tail_cols=fixed_tail_cols,
         mesh=mesh,
     )
@@ -274,6 +528,8 @@ def _dedup_fill_device(idx, ei_rank, q):
         "local_frac",
         "local_sigma",
         "beta",
+        "trust_region",
+        "tr_perturb_dims",
         "fixed_tail_cols",
         "mesh",
     ),
@@ -285,6 +541,7 @@ def _suggest_step(
     mask,
     best_x,
     warm_hypers,
+    tr_length=None,  # required (traced scalar) when trust_region=True
     *,
     q,
     n_candidates,
@@ -294,6 +551,8 @@ def _suggest_step(
     local_frac,
     local_sigma,
     beta,
+    trust_region=False,
+    tr_perturb_dims=20,
     fixed_tail_cols=0,
     mesh=None,
 ):
@@ -308,9 +567,47 @@ def _suggest_step(
     state = fit_gp(x, y, mask, kind=kernel, n_steps=fit_steps, init=warm_hypers)
     k_cand, k_acq = jax.random.split(key)
     d_free = x.shape[1] - fixed_tail_cols
-    free_candidates = _make_candidates(
-        k_cand, n_candidates, d_free, best_x[:d_free], local_frac, local_sigma
-    )
+    if trust_region:
+        cov_chol, elite_mu = _topk_cov_chol(
+            x[:, :d_free], y, mask, d_free, k=min(64, x.shape[0])
+        )
+        lengthscales = jnp.exp(state.hypers.log_lengthscales[:d_free])
+        free_candidates = _make_tr_candidates(
+            k_cand,
+            n_candidates,
+            d_free,
+            best_x[:d_free],
+            tr_length,
+            lengthscales,
+            local_frac,
+            cov_chol,
+            elite_mu,
+            perturb_dims=tr_perturb_dims,
+        )
+        # Gradient-polish a handful of elite-covariance-jittered incumbent
+        # copies on the posterior mean and splice them over the pool's tail
+        # (keeps the pool size, and with it the candidates-divide-mesh
+        # invariant, unchanged) — acquisition still judges them against the
+        # random candidates, so exploitation sharpens without another full
+        # posterior pass over the pool.
+        k_polish = jax.random.fold_in(k_cand, 7)
+        lb, ub = _tr_box(best_x[:d_free], tr_length, lengthscales)
+        starts = jnp.clip(
+            best_x[None, :d_free]
+            + 0.5 * jax.random.normal(k_polish, (8, d_free)) @ cov_chol.T,
+            lb,
+            ub,
+        )
+        polished = _polish_candidates(
+            state, kernel, starts, lb, ub, fixed_tail_cols=fixed_tail_cols
+        )
+        free_candidates = jnp.concatenate(
+            [free_candidates[:-8], polished], axis=0
+        )
+    else:
+        free_candidates = _make_candidates(
+            k_cand, n_candidates, d_free, best_x[:d_free], local_frac, local_sigma
+        )
     if mesh is not None:
         # Data-parallel over the candidate axis: XLA's SPMD partitioner
         # splits generation+scoring per shard and inserts the ICI
@@ -322,7 +619,10 @@ def _suggest_step(
         candidates = jnp.concatenate(
             [
                 free_candidates,
-                jnp.ones((n_candidates, fixed_tail_cols), free_candidates.dtype),
+                jnp.ones(
+                    (free_candidates.shape[0], fixed_tail_cols),
+                    free_candidates.dtype,
+                ),
             ],
             axis=1,
         )
@@ -350,6 +650,12 @@ def _suggest_step(
     ei_rank = select_q(
         expected_improvement(mean, std, best), min(4 * q, n_candidates)
     )
+    if trust_region:
+        # Guarantee one pure-exploitation member per batch: the pool's
+        # posterior-mean minimizer (usually a gradient-polished point).
+        # Thompson noise rarely selects it, yet it is the single highest
+        # expected payoff — CMA-style descent wants it evaluated every round.
+        idx = jnp.concatenate([jnp.argmin(mean)[None], idx])[:q]
     final_idx = _dedup_fill_device(idx, ei_rank, q)
     return jnp.take(free_candidates, final_idx, axis=0), state
 

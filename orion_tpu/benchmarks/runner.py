@@ -81,6 +81,21 @@ PRESETS = {
         strategy="NoParallelStrategy",
         max_trials=4096, batch_size=4096,
     ),
+    # Trust-region GP-BO (TuRBO-style + elite-covariance/directional
+    # candidates + posterior-mean polish) on the same 20-D valley and trial
+    # budget as thompson-rosenbrock20/cmaes-rosenbrock20.  Small batches on
+    # purpose: the trust region adapts once per observe round, and 60 rounds
+    # of success/failure signal are what walk the box down the valley
+    # (measured over 6 seeds: median regret ~173, best ~95 — vs 46 for
+    # cmaes and ~1.3e4 for the global-candidate GP preset).
+    "turbo-rosenbrock20": dict(
+        priors=_uniform_priors(20), fn="rosenbrock20",
+        algorithm={"turbo": {"n_init": 64, "n_candidates": 8192,
+                             "fit_steps": 25, "refit_steps": 6,
+                             "tr_fail_tol": 2, "tr_perturb_dims": 4,
+                             "tr_length_init": 0.4, "tr_length_max": 0.8}},
+        max_trials=1024, batch_size=16,
+    ),
     # Evolution-strategy family on a hard multimodal landscape where GP
     # lengthscales saturate — same budget as thompson-rosenbrock20.
     "cmaes-rosenbrock20": dict(

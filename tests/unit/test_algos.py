@@ -21,6 +21,7 @@ ALGOS = [
     "random",
     {"tpe": {"n_init": 8, "n_candidates": 256}},
     {"tpu_bo": {"n_init": 8, "n_candidates": 256, "fit_steps": 15}},
+    {"turbo": {"n_init": 8, "n_candidates": 256, "fit_steps": 15}},
     {"grid_search": {"n_values": 8}},
     {"cmaes": {"popsize": 8}},
 ]
@@ -174,6 +175,97 @@ def test_refit_steps_gates_on_warm_state(monkeypatch):
     algo.suggest(2)  # cold: full fit
     params = algo.suggest(2)  # warm: cheap refit
     assert seen == [12, 3], seen
+
+
+def _observe_batch(algo, value):
+    """One model-round observation with a scripted objective value."""
+    params = algo.suggest(4)
+    algo.observe(params, [{"objective": value} for _ in params])
+
+
+def test_turbo_trust_region_lifecycle():
+    """Box doubles after tr_succ_tol improving rounds, halves after
+    tr_fail_tol stagnating rounds, and restarts wide below tr_length_min."""
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(
+        space,
+        {"turbo": {"n_init": 4, "n_candidates": 128, "fit_steps": 5,
+                    "tr_succ_tol": 2, "tr_fail_tol": 2,
+                    "tr_length_init": 0.8, "tr_length_min": 0.3,
+                    "tr_length_max": 1.6}},
+        seed=0,
+    )
+    _observe_batch(algo, 10.0)  # init phase: no trust-region bookkeeping
+    assert algo._tr_length == 0.8 and algo._tr_succ == algo._tr_fail == 0
+    # Two consecutive improving model rounds -> box doubles (capped at max).
+    _observe_batch(algo, 5.0)
+    assert algo._tr_succ == 1
+    _observe_batch(algo, 2.0)
+    assert algo._tr_length == 1.6 and algo._tr_succ == 0
+    # Two stagnating rounds -> halve; two more -> below min -> restart wide.
+    _observe_batch(algo, 2.0)
+    _observe_batch(algo, 2.0)
+    assert algo._tr_length == 0.8
+    _observe_batch(algo, 2.0)
+    _observe_batch(algo, 2.0)
+    # 0.4 halves to 0.2 < min 0.3 -> restart at tr_length_init... but 0.8/2
+    # = 0.4 >= 0.3, so one more cycle is needed to collapse.
+    assert algo._tr_length == 0.4
+    _observe_batch(algo, 2.0)
+    _observe_batch(algo, 2.0)
+    assert algo._tr_length == 0.8  # collapsed below min -> restarted
+
+
+def test_turbo_state_roundtrip_preserves_trust_region():
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    cfg = {"turbo": {"n_init": 4, "n_candidates": 128, "fit_steps": 5,
+                      "tr_fail_tol": 2}}
+    algo = create_algo(space, cfg, seed=0)
+    _observe_batch(algo, 10.0)
+    _observe_batch(algo, 9.0)  # improving model round
+    algo._tr_length = 0.31  # distinctive value
+    state = algo.state_dict()
+    other = create_algo(space, cfg, seed=1)
+    other.set_state(state)
+    assert other._tr_length == 0.31
+    assert other._tr_succ == algo._tr_succ
+    assert other._tr_fail == algo._tr_fail
+
+
+def test_tr_candidates_respect_box_and_mask():
+    """Box-source candidates live in the clipped trust box and perturb only
+    a subset of coordinates (the rest stay at the center); every candidate
+    stays inside the unit cube."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.algo.tpu_bo import _make_tr_candidates
+
+    d = 50  # > perturb_dims so the perturbation mask engages (p = 20/50)
+    center = jnp.full((d,), 0.5)
+    ls = jnp.ones((d,))
+    cov_chol = 0.01 * jnp.eye(d)
+    cand = _make_tr_candidates(
+        jax.random.PRNGKey(0), 256, d, center, jnp.asarray(0.4), ls, 1.0,
+        cov_chol, center,
+    )
+    assert cand.shape == (256, d)
+    assert bool(jnp.all(cand >= 0.0)) and bool(jnp.all(cand <= 1.0))
+    # Source order is [global, box, cov, dir]; local_frac=1 -> no global,
+    # cov = dir = 256//4, box = the leading 128 rows.
+    box = cand[:128]
+    # Box: center +- 0.2 (scale 1), clipped to the cube.
+    assert bool(jnp.all(box >= 0.3 - 1e-6)) and bool(jnp.all(box <= 0.7 + 1e-6))
+    at_center = jnp.isclose(box, 0.5).mean(axis=1)
+    # ~60% of coordinates unperturbed on average, and nobody all-center.
+    assert 0.4 < float(at_center.mean()) < 0.8
+    assert float(at_center.max()) < 1.0
 
 
 def test_unseeded_algorithms_have_distinct_streams():
