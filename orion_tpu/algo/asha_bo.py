@@ -28,7 +28,12 @@ import numpy as np
 from orion_tpu.algo.asha import ASHA
 from orion_tpu.algo.base import algo_registry
 from orion_tpu.algo.sampling import clamp_objectives
-from orion_tpu.algo.tpu_bo import run_suggest_step
+from orion_tpu.algo.tpu_bo import (
+    copula_transform,
+    local_subset_indices,
+    run_suggest_step,
+    tr_update,
+)
 
 log = logging.getLogger(__name__)
 
@@ -58,6 +63,16 @@ class ASHABO(ASHA):
         beta=2.0,
         local_frac=0.5,
         local_sigma=0.1,
+        y_transform="none",
+        trust_region=False,
+        tr_length_init=0.4,
+        tr_length_min=0.5**7,
+        tr_length_max=0.8,
+        tr_succ_tol=3,
+        tr_fail_tol=2,
+        tr_improve_tol=1e-3,
+        tr_local_m=512,
+        tr_perturb_dims=20,
     ):
         super().__init__(
             space,
@@ -70,6 +85,11 @@ class ASHABO(ASHA):
             n_init=n_init, n_candidates=n_candidates, kernel=kernel, acq=acq,
             fit_steps=fit_steps, refit_steps=refit_steps, beta=beta,
             local_frac=local_frac, local_sigma=local_sigma,
+            y_transform=y_transform, trust_region=trust_region,
+            tr_length_init=tr_length_init, tr_length_min=tr_length_min,
+            tr_length_max=tr_length_max, tr_succ_tol=tr_succ_tol,
+            tr_fail_tol=tr_fail_tol, tr_improve_tol=tr_improve_tol,
+            tr_local_m=tr_local_m, tr_perturb_dims=tr_perturb_dims,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
@@ -82,6 +102,19 @@ class ASHABO(ASHA):
         self.beta = beta
         self.local_frac = local_frac
         self.local_sigma = local_sigma
+        self.y_transform = y_transform
+        self.trust_region = trust_region
+        self.tr_length_init = tr_length_init
+        self.tr_length_min = tr_length_min
+        self.tr_length_max = tr_length_max
+        self.tr_succ_tol = tr_succ_tol
+        self.tr_fail_tol = tr_fail_tol
+        self.tr_improve_tol = tr_improve_tol
+        self.tr_local_m = tr_local_m
+        self.tr_perturb_dims = tr_perturb_dims
+        self._tr_length = tr_length_init
+        self._tr_succ = 0
+        self._tr_fail = 0
         fid = space.fidelity
         self._log_low = float(np.log(max(fid.low, 1)))
         self._log_span = float(
@@ -132,29 +165,57 @@ class ASHABO(ASHA):
             [self._mf_s, np.asarray(svals, dtype=np.float32)]
         )
         self._mf_y = np.concatenate([self._mf_y, y.astype(np.float32)])
+        prev_best = self._best_seen
         batch_best = float(np.min(y))
         if batch_best < self._best_seen - 1e-9:
             self._best_seen = batch_best
             self._sigma = min(self._sigma * 1.5, 0.4)
         else:
             self._sigma = max(self._sigma * 0.7, 0.005)
+        # Trust-region bookkeeping (tr_update: the one TuRBO schedule),
+        # counted on model rounds only; objectives are comparable across
+        # fidelities for the box signal (a better low-fid value still marks
+        # progress).
+        if self.trust_region and self._mf_y.shape[0] - len(yvals) >= self.n_init:
+            improved = batch_best < prev_best - self.tr_improve_tol * abs(prev_best)
+            self._tr_length, self._tr_succ, self._tr_fail = tr_update(
+                self._tr_length, self._tr_succ, self._tr_fail, improved,
+                succ_tol=self.tr_succ_tol, fail_tol=self.tr_fail_tol,
+                length_init=self.tr_length_init,
+                length_min=self.tr_length_min,
+                length_max=self.tr_length_max,
+            )
 
     # --- model-based sampling -----------------------------------------------
     def _new_cube(self, num):
         n = self._mf_x.shape[0]
         if n < self.n_init:
             return super()._new_cube(num)
+        if self.trust_region:
+            # Global argmin: early TR rounds have almost nothing at the top
+            # tier, and the s-lengthscale already decides how much to trust
+            # low-fidelity values — the incumbent just centers the box.
+            best_row = int(np.argmin(self._mf_y))
+        else:
+            # Best observation at the highest observed fidelity tier.
+            top = self._mf_s >= self._mf_s.max() - 1e-6
+            pool_idx = np.nonzero(top)[0]
+            best_row = pool_idx[int(np.argmin(self._mf_y[pool_idx]))]
+        best_x = self._mf_x[best_row]
+        x_sel, s_sel, y_raw = self._mf_x, self._mf_s, self._mf_y
+        if self.trust_region and n > self.tr_local_m:
+            # Local GP on the nearest observations (x-distance, fidelity
+            # ignored): keeps lengthscales local, Cholesky small.
+            idx = local_subset_indices(self._mf_x, best_x, self.tr_local_m)
+            x_sel, s_sel, y_raw = self._mf_x[idx], self._mf_s[idx], self._mf_y[idx]
+        y_fit = copula_transform(y_raw) if self.y_transform == "copula" else y_raw
         # Augmented inputs [x | s]; the fused step pads/buckets internally.
-        x_aug = np.concatenate([self._mf_x, self._mf_s[:, None]], axis=1)
-        # Incumbent = best observation at the highest observed fidelity tier.
-        top = self._mf_s >= self._mf_s.max() - 1e-6
-        pool_idx = np.nonzero(top)[0]
-        best_row = pool_idx[int(np.argmin(self._mf_y[pool_idx]))]
+        x_aug = np.concatenate([x_sel, s_sel[:, None]], axis=1)
         rows, state = run_suggest_step(
             self.next_key(),
             x_aug,
-            self._mf_y,
-            self._mf_x[best_row],
+            y_fit,
+            best_x,
             self._gp_state,
             num,
             n_candidates=self.n_candidates,
@@ -167,6 +228,9 @@ class ASHABO(ASHA):
             # fused jit, and a freely-varying value would recompile per round.
             local_sigma=float(2.0 ** round(np.log2(self._sigma))),
             beta=self.beta,
+            trust_region=self.trust_region,
+            tr_length=self._tr_length,
+            tr_perturb_dims=self.tr_perturb_dims,
             # Fidelity is context, pinned to s=1 when scoring: selection
             # optimizes predicted FULL-budget value; the rung machinery then
             # assigns the actual bottom-rung fidelity.
@@ -185,6 +249,7 @@ class ASHABO(ASHA):
         out["best_seen"] = (
             None if np.isinf(self._best_seen) else self._best_seen
         )
+        out["tr"] = [self._tr_length, self._tr_succ, self._tr_fail]
         return out
 
     def set_state(self, state):
@@ -196,4 +261,7 @@ class ASHABO(ASHA):
         self._sigma = state.get("sigma", self.local_sigma)
         best = state.get("best_seen")
         self._best_seen = np.inf if best is None else float(best)
+        tr = state.get("tr")
+        if tr is not None:
+            self._tr_length, self._tr_succ, self._tr_fail = tr[0], int(tr[1]), int(tr[2])
         self._gp_state = None

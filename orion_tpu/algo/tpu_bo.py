@@ -38,6 +38,42 @@ def _next_pow2(n, floor=64):
     return out
 
 
+def copula_transform(y):
+    """Rank -> normal quantile on host (monotone: argmin preserved).
+    O(n log n) over a few thousand floats per round, noise next to the
+    device dispatch.  Shared by tpu_bo and asha_bo so the y-transform
+    semantics cannot diverge."""
+    from scipy.special import ndtri
+
+    order = np.argsort(np.argsort(y))
+    return ndtri((order + 0.5) / y.shape[0]).astype(np.float32)
+
+
+def local_subset_indices(x, center, m):
+    """Indices of the m nearest rows to ``center`` (local-GP selection)."""
+    d2 = ((x - center[None, :]) ** 2).sum(axis=1)
+    return np.argpartition(d2, m)[:m]
+
+
+def tr_update(length, succ, fail, improved, *, succ_tol, fail_tol,
+              length_init, length_min, length_max):
+    """One trust-region bookkeeping step (TuRBO schedule), shared by every
+    algorithm hosting a box: expand after ``succ_tol`` consecutive improving
+    rounds, halve after ``fail_tol`` stagnating ones, restart wide on
+    collapse (history is kept — only the box resets)."""
+    if improved:
+        succ, fail = succ + 1, 0
+    else:
+        succ, fail = 0, fail + 1
+    if succ >= succ_tol:
+        length, succ = min(2.0 * length, length_max), 0
+    elif fail >= fail_tol:
+        length, fail = length / 2.0, 0
+    if length < length_min:
+        length, succ, fail = length_init, 0, 0
+    return length, succ, fail
+
+
 @algo_registry.register("tpu_bo")
 class TPUBO(BaseAlgorithm):
     """Batched GP-BO on device.
@@ -174,23 +210,14 @@ class TPUBO(BaseAlgorithm):
             new_best = float(np.min(self._y))
             # TuRBO's improvement test: a material relative gain, so noise
             # floors don't keep an exhausted region alive forever.
-            if new_best < prev_best - self.tr_improve_tol * abs(prev_best):
-                self._tr_succ += 1
-                self._tr_fail = 0
-            else:
-                self._tr_fail += 1
-                self._tr_succ = 0
-            if self._tr_succ >= self.tr_succ_tol:
-                self._tr_length = min(2.0 * self._tr_length, self.tr_length_max)
-                self._tr_succ = 0
-            elif self._tr_fail >= self.tr_fail_tol:
-                self._tr_length /= 2.0
-                self._tr_fail = 0
-            if self._tr_length < self.tr_length_min:
-                # Collapsed region: restart wide.  History is kept — the GP
-                # still knows the landscape; only the box resets.
-                self._tr_length = self.tr_length_init
-                self._tr_succ = self._tr_fail = 0
+            improved = new_best < prev_best - self.tr_improve_tol * abs(prev_best)
+            self._tr_length, self._tr_succ, self._tr_fail = tr_update(
+                self._tr_length, self._tr_succ, self._tr_fail, improved,
+                succ_tol=self.tr_succ_tol, fail_tol=self.tr_fail_tol,
+                length_init=self.tr_length_init,
+                length_min=self.tr_length_min,
+                length_max=self.tr_length_max,
+            )
 
     # --- suggestion ---------------------------------------------------------
     def _suggest_cube(self, num):
@@ -211,18 +238,9 @@ class TPUBO(BaseAlgorithm):
             # lengthscales over the whole landscape, washing out exactly the
             # local structure the trust region is trying to exploit — and a
             # 4x smaller buffer makes the per-round Cholesky ~64x cheaper.
-            d2 = ((self._x - best_x[None, :]) ** 2).sum(axis=1)
-            idx = np.argpartition(d2, self.tr_local_m)[: self.tr_local_m]
+            idx = local_subset_indices(self._x, best_x, self.tr_local_m)
             x_fit, y_raw = self._x[idx], self._y[idx]
-        y_fit = y_raw
-        if self.y_transform == "copula":
-            # Rank -> normal quantile on host: O(n log n) over a few thousand
-            # floats per round, noise next to the device dispatch.  argmin is
-            # preserved (monotone), so best_x/TR bookkeeping stay on raw y.
-            from scipy.special import ndtri
-
-            order = np.argsort(np.argsort(y_raw))
-            y_fit = ndtri((order + 0.5) / y_raw.shape[0]).astype(np.float32)
+        y_fit = copula_transform(y_raw) if self.y_transform == "copula" else y_raw
         rows, state = run_suggest_step(
             self.next_key(),
             x_fit,
@@ -386,11 +404,12 @@ def _make_tr_candidates(
     principal directions (see _topk_cov_chol), which is what actually walks
     curved valleys.  Traced on ``tr_length``/``cov_chol`` so box resizing
     and covariance updates never recompile."""
-    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+    k1, k2, k3, k4, k5, k6, k7 = jax.random.split(key, 7)
     n_local = int(n_candidates * local_frac)
-    n_cov = n_local // 4
-    n_dir = n_local // 4
-    n_box = n_local - n_cov - n_dir
+    n_cov = n_local // 6
+    n_dir = n_local // 6
+    n_cem = n_local // 6
+    n_box = n_local - n_cov - n_dir - n_cem
     n_global = n_candidates - n_local
     lb, ub = _tr_box(center, tr_length, lengthscales)
     u = jax.random.uniform(k1, (n_box, n_dims))
@@ -413,16 +432,25 @@ def _make_tr_candidates(
     sigma = jnp.where(jnp.arange(n_cov)[:, None] % 2 == 0, 1.0, 2.0)
     cov_c = reflect_unit(center[None, :] + sigma * (z @ cov_chol.T))
     # Directional extrapolation: the elite mean trails the incumbent while
-    # the search descends, so (center - mu) points ALONG the descent path —
-    # step out at assorted magnitudes with a little covariance-shaped noise
-    # (the momentum CMA-ES gets from moving its recombination mean).
-    t = jnp.abs(jax.random.normal(k5, (n_dir, 1))) * 2.0
+    # the search descends, so (center - mu) spans the descent path — step
+    # at assorted magnitudes BOTH ways (t symmetric: valley landscapes
+    # reward pushing past the incumbent, basin landscapes reward stepping
+    # back toward the elite mean; acquisition judges which) with a little
+    # covariance-shaped noise (the momentum CMA-ES gets from moving its
+    # recombination mean).
+    t = jax.random.normal(k5, (n_dir, 1)) * 2.0
     zd = jax.random.normal(k6, (n_dir, n_dims))
     dir_c = reflect_unit(
         center[None, :] + t * (center - elite_mu)[None, :] + 0.5 * (zd @ cov_chol.T)
     )
+    # CEM-style recombination: samples around the elite MEAN.  Averaging the
+    # top-k concentrates each coordinate ~sqrt(k)x tighter than any single
+    # elite point, so on basin landscapes mu sits far closer to the optimum
+    # than the incumbent — a move no incumbent-centered source can make.
+    zc = jax.random.normal(k7, (n_cem, n_dims))
+    cem_c = reflect_unit(elite_mu[None, :] + zc @ cov_chol.T)
     global_c = jax.random.uniform(jax.random.fold_in(k1, 1), (n_global, n_dims))
-    return jnp.concatenate([global_c, box, cov_c, dir_c], axis=0)
+    return jnp.concatenate([global_c, box, cov_c, dir_c, cem_c], axis=0)
 
 
 def run_suggest_step(
@@ -592,9 +620,12 @@ def _suggest_step(
         # posterior pass over the pool.
         k_polish = jax.random.fold_in(k_cand, 7)
         lb, ub = _tr_box(best_x[:d_free], tr_length, lengthscales)
+        # Scale the exploiter count with the batch: at q=512 eight polished
+        # points would be a rounding error in the pool.
+        n_polish = min(64, max(8, q // 16))
         starts = jnp.clip(
             best_x[None, :d_free]
-            + 0.5 * jax.random.normal(k_polish, (8, d_free)) @ cov_chol.T,
+            + 0.5 * jax.random.normal(k_polish, (n_polish, d_free)) @ cov_chol.T,
             lb,
             ub,
         )
@@ -602,7 +633,7 @@ def _suggest_step(
             state, kernel, starts, lb, ub, fixed_tail_cols=fixed_tail_cols
         )
         free_candidates = jnp.concatenate(
-            [free_candidates[:-8], polished], axis=0
+            [free_candidates[:-n_polish], polished], axis=0
         )
     else:
         free_candidates = _make_candidates(

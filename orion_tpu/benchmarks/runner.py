@@ -66,20 +66,30 @@ PRESETS = {
         algorithm={"tpu_bo": {"n_init": 256, "n_candidates": 16384, "fit_steps": 30}},
         max_trials=1024, batch_size=256,
     ),
+    # Multi-round schedule (q=512 under a 5-rung fidelity ladder, same
+    # 4096-trial budget as round 2's single q=4096 shot) so the model-based
+    # variants below actually get observation rounds to learn from — a
+    # single-batch run measures scheduling only, and a shallow ladder lets
+    # ASHA's is-done (first top-rung completion, reference parity
+    # `asha.py:312-314`) fire before the models can act on what they saw.
     "asha-ackley50": dict(
-        priors={**_uniform_priors(50), "budget": "fidelity(1, 16, 4)"},
-        fn="ackley50", algorithm="asha", strategy="NoParallelStrategy",
-        max_trials=4096, batch_size=4096,
+        priors={**_uniform_priors(50), "budget": "fidelity(1, 256, 4)"},
+        fn="ackley50", algorithm={"asha": {"num_brackets": 3}},
+        strategy="NoParallelStrategy",
+        max_trials=4096, batch_size=512,
     ),
     # Config #5 model-based (round-1 verdict #10): fidelity-aware GP sampling
     # under the same ASHA scheduling/budget — compare against asha-ackley50.
     "asha_bo-ackley50": dict(
-        priors={**_uniform_priors(50), "budget": "fidelity(1, 16, 4)"},
+        priors={**_uniform_priors(50), "budget": "fidelity(1, 256, 4)"},
         fn="ackley50",
         algorithm={"asha_bo": {"n_init": 128, "n_candidates": 8192,
-                               "fit_steps": 30, "local_frac": 0.7}},
+                               "fit_steps": 30, "refit_steps": 10,
+                               "local_frac": 0.8, "trust_region": True,
+                               "y_transform": "copula",
+                               "tr_perturb_dims": 12, "num_brackets": 3}},
         strategy="NoParallelStrategy",
-        max_trials=4096, batch_size=4096,
+        max_trials=4096, batch_size=512,
     ),
     # Trust-region GP-BO (TuRBO-style + elite-covariance/directional
     # candidates + posterior-mean polish) on the same 20-D valley and trial
@@ -111,11 +121,11 @@ PRESETS = {
     # TPE-under-Hyperband on the multi-fidelity config, comparable against
     # asha-ackley50 / asha_bo-ackley50 at equal trial budget.
     "bohb-ackley50": dict(
-        priors={**_uniform_priors(50), "budget": "fidelity(1, 16, 4)"},
+        priors={**_uniform_priors(50), "budget": "fidelity(1, 256, 4)"},
         fn="ackley50",
         algorithm={"bohb": {"n_candidates": 8192, "min_points": 64}},
         strategy="NoParallelStrategy",
-        max_trials=4096, batch_size=4096,
+        max_trials=4096, batch_size=512,
     ),
 }
 

@@ -249,23 +249,28 @@ def test_tr_candidates_respect_box_and_mask():
 
     d = 50  # > perturb_dims so the perturbation mask engages (p = 20/50)
     center = jnp.full((d,), 0.5)
+    elite_mu = jnp.full((d,), 0.25)
     ls = jnp.ones((d,))
     cov_chol = 0.01 * jnp.eye(d)
+    n = 192
     cand = _make_tr_candidates(
-        jax.random.PRNGKey(0), 256, d, center, jnp.asarray(0.4), ls, 1.0,
-        cov_chol, center,
+        jax.random.PRNGKey(0), n, d, center, jnp.asarray(0.4), ls, 1.0,
+        cov_chol, elite_mu,
     )
-    assert cand.shape == (256, d)
+    assert cand.shape == (n, d)
     assert bool(jnp.all(cand >= 0.0)) and bool(jnp.all(cand <= 1.0))
-    # Source order is [global, box, cov, dir]; local_frac=1 -> no global,
-    # cov = dir = 256//4, box = the leading 128 rows.
-    box = cand[:128]
+    # Source order is [global, box, cov, dir, cem]; local_frac=1 -> no
+    # global; cov = dir = cem = n//6 = 32, box = the remaining 96 rows.
+    box, cem = cand[:96], cand[-32:]
     # Box: center +- 0.2 (scale 1), clipped to the cube.
     assert bool(jnp.all(box >= 0.3 - 1e-6)) and bool(jnp.all(box <= 0.7 + 1e-6))
     at_center = jnp.isclose(box, 0.5).mean(axis=1)
     # ~60% of coordinates unperturbed on average, and nobody all-center.
     assert 0.4 < float(at_center.mean()) < 0.8
     assert float(at_center.max()) < 1.0
+    # CEM source clusters around the elite MEAN (cov scale 0.01), not the
+    # incumbent — the recombination move incumbent-centered sources can't make.
+    assert bool(jnp.all(jnp.abs(cem - 0.25) < 0.06))
 
 
 def test_unseeded_algorithms_have_distinct_streams():

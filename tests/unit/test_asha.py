@@ -198,6 +198,35 @@ def test_asha_bo_state_roundtrip():
     assert out and len(out) == 4
 
 
+def test_asha_bo_trust_region_mode():
+    """TR + copula mode: suggest stays valid past n_init, the box reacts to
+    stagnation, and the TR state survives a state_dict roundtrip."""
+    import numpy as np
+
+    from orion_tpu.algo.base import create_algo
+
+    cfg = {"asha_bo": {"n_init": 8, "n_candidates": 256, "fit_steps": 5,
+                        "trust_region": True, "y_transform": "copula",
+                        "tr_fail_tol": 2, "tr_length_init": 0.4}}
+    space = _mf_space()
+    algo = create_algo(space, cfg, seed=0)
+    rng = np.random.default_rng(0)
+    params = algo.suggest(8)
+    algo.observe(params, [{"objective": float(rng.normal())} for _ in params])
+    assert algo._tr_length == 0.4  # init batch: no TR bookkeeping
+    # Two stagnating model rounds (objectives never improve) -> box halves.
+    for value in (5.0, 5.0):
+        params = algo.suggest(4)
+        assert params and all(0.0 <= p["x0"] <= 1.0 for p in params)
+        algo.observe(params, [{"objective": value} for _ in params])
+    assert algo._tr_length == 0.2
+    state = algo.state_dict()
+    clone = create_algo(space, cfg, seed=1)
+    clone.set_state(state)
+    assert clone._tr_length == algo._tr_length
+    assert clone.suggest(4)
+
+
 def test_asha_bo_beats_plain_asha_on_ackley():
     """Round-1 verdict #10 done-criterion, scaled to test size: model-based
     sampling beats uniform sampling under identical ASHA scheduling/budget."""
