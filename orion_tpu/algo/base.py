@@ -31,6 +31,24 @@ class BaseAlgorithm:
 
     requires_fidelity = False
 
+    # True for algorithms whose `_suggest_cube` returns an UNFORCED device
+    # array (jax dispatch is asynchronous): the producer may then split
+    # suggestion into dispatch_suggest/finalize_suggest and overlap the
+    # device round trip with trial execution.  Algorithms that override
+    # `suggest` itself with host-side scheduling (ASHA's promotions) or
+    # compute on host must leave this False.
+    supports_async_suggest = False
+
+    # True ONLY when a suggestion conditioned on round-(k-1) state is
+    # EXACTLY as good as one conditioned on round k (i.e. suggestions do
+    # not depend on observations at all — random/grid).  The producer
+    # speculatively dispatches the next round's suggest for such
+    # algorithms.  Model-based algorithms must leave this False: measured
+    # on Hartmann6, fantasy-conditioned speculation costs real regret
+    # (0.13 -> 0.21) because constant-liar lies mark the previous batch's
+    # genuinely-good region as bad.
+    speculation_safe = False
+
     # The producer deepcopies the algorithm every round for its naive copy
     # (lie fantasization); these class attributes let subclasses exempt
     # fields from that copy without each reimplementing __deepcopy__:
@@ -115,6 +133,29 @@ class BaseAlgorithm:
 
     def _suggest_cube(self, num):
         raise NotImplementedError
+
+    # --- asynchronous suggestion (device-overlap path) ----------------------
+    def dispatch_suggest(self, num=1):
+        """Start the device computation for ``num`` suggestions WITHOUT
+        forcing the result to host.  Returns an opaque handle for
+        :meth:`finalize_suggest`, or None (opt-out / unsupported).  The
+        computation and the device->host transfer proceed in the background
+        (jax async dispatch), so the caller can run trials, write storage,
+        etc. before finalizing."""
+        if not self.supports_async_suggest:
+            return None
+        cube = self._suggest_cube(num)
+        if cube is None:
+            return None
+        return (num, cube)
+
+    def finalize_suggest(self, handle):
+        """Force a :meth:`dispatch_suggest` handle to concrete params."""
+        num, cube = handle
+        arrays = self.space.decode_flat_np(np.asarray(cube)[:num])
+        return self.space.arrays_to_params(
+            arrays, fidelity_value=self._fidelity_for_new()
+        )
 
     def _fidelity_for_new(self):
         """Fidelity assigned to fresh points (max budget unless multi-fidelity

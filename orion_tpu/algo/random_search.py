@@ -17,6 +17,9 @@ from orion_tpu.algo.base import BaseAlgorithm, algo_registry
 class RandomSearch(BaseAlgorithm):
     """Uniform prior sampling; seeded, resumable."""
 
+    supports_async_suggest = True
+    speculation_safe = True  # suggestions ignore observations entirely
+
     def __init__(self, space, seed=None):
         super().__init__(space, seed=seed)
 

@@ -110,6 +110,8 @@ class TPUBO(BaseAlgorithm):
     n_devices: shard candidates over this many devices (None = all visible).
     """
 
+    supports_async_suggest = True
+
     def __init__(
         self,
         space,
@@ -133,6 +135,7 @@ class TPUBO(BaseAlgorithm):
         tr_improve_tol=1e-3,
         tr_local_m=256,
         tr_perturb_dims=20,
+        speculative_suggest=False,
         n_devices=None,
         use_mesh=False,
     ):
@@ -158,6 +161,7 @@ class TPUBO(BaseAlgorithm):
             tr_improve_tol=tr_improve_tol,
             tr_local_m=tr_local_m,
             tr_perturb_dims=tr_perturb_dims,
+            speculative_suggest=speculative_suggest,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
@@ -180,6 +184,12 @@ class TPUBO(BaseAlgorithm):
         self.tr_improve_tol = tr_improve_tol
         self.tr_local_m = tr_local_m
         self.tr_perturb_dims = tr_perturb_dims
+        # Opt-in async-BO semantics: let the producer dispatch next round's
+        # suggest conditioned on constant-liar fantasies for the in-flight
+        # batch.  Hides the device round trip behind trial execution, at the
+        # one-round-stale conditioning cost every async multi-worker setup
+        # already accepts (measured on Hartmann6: regret 0.13 -> 0.21).
+        self.speculation_safe = bool(speculative_suggest)
         self.use_mesh = use_mesh
         self._mesh = device_mesh(n_devices) if use_mesh else None
         d = space.n_cols
@@ -517,8 +527,12 @@ def run_suggest_step(
         mesh=mesh,
     )
     # Dedup ordered unique draws first, so the first `num` rows are the ones
-    # the un-padded call would have returned.
-    return np.asarray(rows)[:num], state
+    # the un-padded call would have returned.  Rows come back as a DEVICE
+    # array slice: jax dispatch is asynchronous, so callers that defer the
+    # host transfer (BaseAlgorithm.suggest's np.asarray, or the producer's
+    # speculative prefetch) overlap the ~100ms tunnel round trip with host
+    # work instead of blocking here.
+    return rows[:num], state
 
 
 def _dedup_fill_device(idx, ei_rank, q):

@@ -36,6 +36,9 @@ class Producer:
         self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
         self.failure_count = 0
         self._pending_timings = []
+        # Speculative next-round suggestion: (handle, algo) dispatched at the
+        # end of produce() so the device round trip overlaps trial execution.
+        self._speculative = None
         # Probe the EVC family ONCE: walking the tree costs extra collection
         # scans per round (each a full lock/unpickle on the file backend),
         # which an un-branched experiment should never pay.  A branch
@@ -132,32 +135,40 @@ class Producer:
         pool_size = pool_size or self.experiment.pool_size
         registered = 0
         start = time.time()
+        speculative = self._take_speculative(pool_size)
+        registered_trials = []
         while registered < pool_size:
             if time.time() - start > self.max_idle_time:
                 raise SampleTimeout(
                     f"algorithm produced no new unique point in {self.max_idle_time}s"
                 )
             t0 = time.perf_counter()
-            suggested = self.naive_algorithm.suggest(pool_size - registered)
-            if suggested is not None:
-                self._record_timing(
-                    "suggest", time.perf_counter() - t0, len(suggested)
-                )
+            if speculative is not None:
+                # Already timed by _take_speculative (the residual transfer).
+                suggested, speculative = speculative, None
+            else:
+                suggested = self.naive_algorithm.suggest(pool_size - registered)
+                # Advance ONLY the real algo's RNG stream, never its full
+                # state: the naive copy has observed fantasy lies, and
+                # syncing its whole state_dict would permanently inject
+                # those rows into the real algorithm (compounding every
+                # round).
+                self.algorithm.rng_key = self.naive_algorithm.rng_key
+                if suggested is not None:
+                    self._record_timing(
+                        "suggest", time.perf_counter() - t0, len(suggested)
+                    )
             if suggested is None:
                 log.debug("algorithm opted out of suggesting; backing off")
                 self.backoff()
                 continue
-            # Advance ONLY the real algo's RNG stream, never its full state:
-            # the naive copy has observed fantasy lies, and syncing its whole
-            # state_dict would permanently inject those rows into the real
-            # algorithm (compounding every round).
-            self.algorithm.rng_key = self.naive_algorithm.rng_key
-            for params in suggested:
+            for params in suggested[: pool_size - registered]:
                 trial = Trial(params=params)
                 try:
                     self.experiment.register_trial(trial, parents=self._leaf_ids)
                     self.algorithm.register_suggestion(params)
                     registered += 1
+                    registered_trials.append(trial)
                 except DuplicateKeyError:
                     # The point IS durably registered (by us earlier or by a
                     # concurrent worker) — the algorithm must still learn it
@@ -166,7 +177,63 @@ class Producer:
                     log.debug("duplicate suggestion %s; backing off", trial.id)
                     self.backoff()
         self._flush_timings()
+        self._dispatch_speculative(pool_size, registered_trials)
         return registered
+
+    # --- speculative overlap ------------------------------------------------
+    def _dispatch_speculative(self, pool_size, registered_trials):
+        """Dispatch the NEXT round's device suggest before this round's
+        trials execute (VERDICT r2 #3: the small-batch presets were pinned
+        to one blocking ~100ms host<->device round trip per round).
+
+        Only algorithms declaring ``speculation_safe`` are speculated.
+        Observation-independent algorithms (random search) declare it by
+        class — zero regret cost by construction.  Model-based algorithms
+        opt in (`speculative_suggest=True`, async-BO semantics): the naive
+        copy first observes constant-liar lies for the just-registered
+        batch so the speculative batch is conditioned like an async
+        worker's round would be, not drawn from the identical posterior.
+        jax's async dispatch runs the computation and transfer while the
+        host executes trials; the next produce() call picks up the result."""
+        self._speculative = None
+        algo = self.naive_algorithm
+        if algo is None or not getattr(algo, "speculation_safe", False):
+            return
+        try:
+            if registered_trials:
+                lies = []
+                for trial in registered_trials:
+                    lie = self.strategy.lie(trial)
+                    if lie is not None and lie.value is not None:
+                        lies.append((dict(trial.params), {"objective": lie.value}))
+                if lies:
+                    algo.observe([p for p, _ in lies], [r for _, r in lies])
+            handle = algo.dispatch_suggest(pool_size)
+        except Exception:  # pragma: no cover - speculation must never break a run
+            log.debug("speculative dispatch failed", exc_info=True)
+            return
+        if handle is None:
+            return
+        # Keep the real algo's rng stream ahead of the speculative draw, or
+        # the next naive copy would replay the same key and duplicate it.
+        self.algorithm.rng_key = algo.rng_key
+        self._speculative = (handle, algo)
+
+    def _take_speculative(self, pool_size):
+        spec, self._speculative = self._speculative, None
+        if spec is None:
+            return None
+        handle, algo = spec
+        try:
+            t0 = time.perf_counter()
+            out = algo.finalize_suggest(handle)[:pool_size]
+            # Timed as "suggest": what remains of the device round trip
+            # after the overlap (ideally just the residual transfer).
+            self._record_timing("suggest", time.perf_counter() - t0, len(out))
+            return out
+        except Exception:  # pragma: no cover - speculation must never break a run
+            log.debug("speculative finalize failed", exc_info=True)
+            return None
 
     def backoff(self):
         """Re-sync with storage + jittered sleep (reference `producer.py:61-67`)."""
