@@ -54,4 +54,19 @@ def main(argv=None):
     if not getattr(args, "func", None):
         parser.print_help()
         return 1
-    return args.func(args) or 0
+    from orion_tpu.utils.exceptions import (
+        CheckError,
+        DatabaseError,
+        NoConfigurationError,
+    )
+
+    try:
+        return args.func(args) or 0
+    except (NoConfigurationError, DatabaseError, CheckError) as exc:
+        # Expected operational failures (bad credentials, unreachable or
+        # misconfigured storage) get a one-line error, not a traceback;
+        # -v re-raises for debugging.
+        if args.verbose:
+            raise
+        print(f"ERROR: {exc}", file=sys.stderr)
+        return 1

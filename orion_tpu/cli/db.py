@@ -31,6 +31,10 @@ def add_subparser(subparsers):
     setup_p.add_argument("--path", default=None, help="DB file path (pickled/sqlite)")
     setup_p.add_argument("--host", default="127.0.0.1", help="network DB host")
     setup_p.add_argument("--port", type=int, default=8765, help="network DB port")
+    setup_p.add_argument(
+        "--secret-file", default=None,
+        help="shared-secret file for an authenticated network server",
+    )
     setup_p.set_defaults(func=main_setup)
 
     serve_p = sub.add_parser(
@@ -42,6 +46,20 @@ def add_subparser(subparsers):
         "--persist",
         default=None,
         help="snapshot file so the server can restart without losing state",
+    )
+    serve_p.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the shared secret clients must prove knowledge of "
+        "(HMAC handshake; the secret never crosses the wire).  Clients set "
+        "ORION_DB_SECRET_FILE or storage.secret_file.",
+    )
+    serve_p.add_argument(
+        "--no-auth",
+        action="store_true",
+        help="explicitly run WITHOUT authentication (localhost development "
+        "only — any peer that can reach the port can read and corrupt "
+        "experiments)",
     )
     serve_p.set_defaults(func=main_serve)
 
@@ -196,9 +214,28 @@ def main_copy(args):
 
 
 def main_serve(args):
+    import sys
+
     from orion_tpu.storage.netdb import serve
 
-    serve(host=args.host, port=args.port, persist=args.persist)
+    secret = None
+    if args.secret_file:
+        with open(args.secret_file) as handle:
+            secret = handle.read().strip()
+        if not secret:
+            print(f"ERROR: secret file {args.secret_file} is empty", file=sys.stderr)
+            return 1
+    elif not args.no_auth:
+        # Secure by default: binding 0.0.0.0 without credentials hands the
+        # whole experiment to anyone on the network.
+        print(
+            "ERROR: refusing to serve without authentication.  Pass "
+            "--secret-file <path> (recommended), or --no-auth for localhost "
+            "development.",
+            file=sys.stderr,
+        )
+        return 1
+    serve(host=args.host, port=args.port, persist=args.persist, secret=secret)
     return 0
 
 
@@ -209,6 +246,8 @@ def main_setup(args):
     if args.storage_type == "network":
         storage["host"] = args.host
         storage["port"] = args.port
+        if args.secret_file:
+            storage["secret_file"] = os.path.abspath(args.secret_file)
     elif args.path:
         storage["path"] = os.path.abspath(args.path)
     elif args.storage_type in ("pickled", "sqlite"):

@@ -378,6 +378,30 @@ def _parse_network_address(config):
     return host, int(port)
 
 
+def _resolve_network_secret(config):
+    """Shared secret for the network driver: explicit config value, a
+    secret file (config or ORION_DB_SECRET_FILE), or ORION_DB_SECRET.
+    None = unauthenticated client (open/localhost servers)."""
+    import os
+
+    if config.get("secret") is not None:
+        return str(config["secret"])
+    path = config.get("secret_file") or os.getenv("ORION_DB_SECRET_FILE")
+    if path:
+        try:
+            with open(path) as handle:
+                secret = handle.read().strip()
+        except OSError as exc:
+            raise DatabaseError(
+                f"cannot read network DB secret file {path!r}: {exc} "
+                "(is the shared mount available on this node?)"
+            ) from exc
+        if not secret:
+            raise DatabaseError(f"network DB secret file {path!r} is empty")
+        return secret
+    return os.getenv("ORION_DB_SECRET") or None
+
+
 def create_storage(config=None):
     """Build a storage instance from a config dict.
 
@@ -400,7 +424,12 @@ def create_storage(config=None):
 
         host, port = _parse_network_address(config)
         return DocumentStorage(
-            NetworkDB(host=host, port=port, timeout=config.get("timeout", 60.0))
+            NetworkDB(
+                host=host,
+                port=port,
+                timeout=config.get("timeout", 60.0),
+                secret=_resolve_network_secret(config),
+            )
         )
     raise DatabaseError(f"Unknown storage type {db_type!r}")
 

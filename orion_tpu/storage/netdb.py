@@ -23,10 +23,12 @@ store (every mutation committed, WAL); any other path uses rate-limited
 pickle snapshots (atomic tempfile + rename, same pattern as PickledDB).
 """
 
+import hmac
 import json
 import logging
 import os
 import pickle
+import secrets as _secrets
 import socket
 import socketserver
 import threading
@@ -34,7 +36,11 @@ import time
 
 from orion_tpu.storage.backends import atomic_pickle_dump
 from orion_tpu.storage.documents import MemoryDB
-from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
+from orion_tpu.utils.exceptions import (
+    AuthenticationError,
+    DatabaseError,
+    DuplicateKeyError,
+)
 
 log = logging.getLogger(__name__)
 
@@ -78,6 +84,28 @@ def _dumps(obj):
     return json.dumps(obj, cls=_JSONEncoder).encode() + _TERM
 
 
+import functools
+import hashlib
+
+
+@functools.lru_cache(maxsize=8)
+def _derive_key(secret):
+    """PBKDF2-stretched key from the shared secret (100k iterations, once
+    per process): a captured handshake MAC then costs an offline attacker
+    100k hashes per password guess instead of one — the standard defense
+    for human-chosen secrets, same idea as MongoDB's SCRAM iteration
+    count."""
+    return hashlib.pbkdf2_hmac(
+        "sha256", secret.encode(), b"orion-tpu-netdb-v1", 100_000
+    )
+
+
+def _mac(key, *parts):
+    """HMAC-SHA256 over the concatenated handshake parts — the secret itself
+    never crosses the wire, and per-connection nonces kill replay."""
+    return hmac.new(key, "|".join(parts).encode(), "sha256").hexdigest()
+
+
 def _read_line(sock_file):
     line = sock_file.readline(_MAX_LINE)
     if not line:
@@ -88,6 +116,10 @@ def _read_line(sock_file):
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         db = self.server.db
+        # No server secret -> open server (localhost dev, --no-auth).
+        self._authenticated = self.server.secret is None
+        self._auth_nonce = None
+        self._hangup = False
         while True:
             try:
                 request = _read_line(self.rfile)
@@ -97,13 +129,69 @@ class _Handler(socketserver.StreamRequestHandler):
             if request is None:
                 return
             self.wfile.write(_dumps(self._dispatch(db, request)))
+            if self._hangup:
+                # Failed credential check: force a reconnect (and a fresh
+                # nonce) per guess, so brute force pays a TCP handshake each.
+                return
+
+    def _auth_dispatch(self, request):
+        """Two-step mutual handshake, CLIENT proves first: hello -> nonces,
+        auth -> client proof, verified before the server's own proof is
+        released.  Handing out a server MAC pre-verification would give any
+        port-scanner a free chosen-nonce sample to brute-force offline."""
+        op = request["op"]
+        key = self.server.auth_key
+        if op == "auth_hello":
+            if key is None:
+                return {"ok": True, "result": {"nonce": None}}
+            self._auth_client_nonce = str(request.get("nonce", ""))
+            self._auth_nonce = _secrets.token_hex(32)
+            return {"ok": True, "result": {"nonce": self._auth_nonce}}
+        # op == "auth"
+        nonce, self._auth_nonce = self._auth_nonce, None  # one-shot
+        client_nonce = getattr(self, "_auth_client_nonce", "")
+        expected = (
+            None
+            if (key is None or nonce is None)
+            else _mac(key, "client", client_nonce, nonce)
+        )
+        if expected is not None and hmac.compare_digest(
+            str(request.get("mac", "")), expected
+        ):
+            self._authenticated = True
+            return {
+                "ok": True,
+                "result": {
+                    "status": "authenticated",
+                    # Mutual: released only to a proven client, so an
+                    # impostor server (or mismatched secret files) is
+                    # detected client-side before any data flows.
+                    "server_mac": _mac(key, "server", client_nonce, nonce),
+                },
+            }
+        self._hangup = True
+        return {
+            "ok": False,
+            "error": "AuthenticationError",
+            "message": "bad credentials (wrong or missing shared secret)",
+        }
 
     def _dispatch(self, db, request):
         op = request.get("op")
+        if op in ("auth_hello", "auth"):
+            return self._auth_dispatch(request)
         if op not in _DB_OPS:
             return {"ok": False, "error": "DatabaseError", "message": f"bad op {op!r}"}
         if op == "ping":
+            # Health checks stay open: ping reveals nothing and monitoring
+            # should not need the experiment secret.
             return {"ok": True, "result": "pong"}
+        if not self._authenticated:
+            return {
+                "ok": False,
+                "error": "AuthenticationError",
+                "message": "authentication required (server started with a secret)",
+            }
         try:
             method = getattr(db, op)
             result = method(*request.get("args", []), **request.get("kwargs", {}))
@@ -128,9 +216,21 @@ class DBServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host="127.0.0.1", port=0, persist=None, persist_interval=1.0):
+    def __init__(
+        self,
+        host="127.0.0.1",
+        port=0,
+        persist=None,
+        persist_interval=1.0,
+        secret=None,
+    ):
         self.persist = persist
         self.persist_interval = persist_interval
+        # Shared-secret authentication (reference parity: the networked
+        # backend takes username/password credentials,
+        # `mongodb.py:86,289`).  None = open server for localhost dev.
+        self.secret = secret
+        self.auth_key = _derive_key(secret) if secret is not None else None
         self._persist_lock = threading.Lock()
         self._dirty = threading.Event()
         self._stop_flusher = threading.Event()
@@ -192,11 +292,15 @@ class DBServer(socketserver.ThreadingTCPServer):
         return self.address
 
 
-def serve(host="127.0.0.1", port=8765, persist=None):  # pragma: no cover - CLI
+def serve(host="127.0.0.1", port=8765, persist=None, secret=None):  # pragma: no cover - CLI
     """Blocking server entry point (`orion-tpu db serve`)."""
-    server = DBServer(host=host, port=port, persist=persist)
+    server = DBServer(host=host, port=port, persist=persist, secret=secret)
     log.info("serving orion-tpu DB on %s:%s", *server.address)
-    print(f"orion-tpu db server listening on {server.address[0]}:{server.address[1]}")
+    auth = "shared-secret auth" if secret else "NO auth (open server)"
+    print(
+        f"orion-tpu db server listening on "
+        f"{server.address[0]}:{server.address[1]} ({auth})"
+    )
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -219,11 +323,15 @@ class NetworkDB:
     genuinely unknowable without server-side request ids.
     """
 
-    def __init__(self, host="127.0.0.1", port=8765, timeout=60.0, idle_probe=1.0):
+    def __init__(
+        self, host="127.0.0.1", port=8765, timeout=60.0, idle_probe=1.0,
+        secret=None,
+    ):
         self.host = host
         self.port = int(port)
         self.timeout = timeout
         self.idle_probe = idle_probe
+        self.secret = secret
         self._lock = threading.Lock()
         self._sock = None
         self._file = None
@@ -237,6 +345,45 @@ class NetworkDB:
         self._sock = sock
         self._file = sock.makefile("rb")
         self._last_used = time.monotonic()
+        if self.secret is not None:
+            self._authenticate()
+
+    def _authenticate(self):
+        """Mutual HMAC handshake on a fresh connection (reconnects redo it):
+        client proves first, then verifies the server proof released with
+        the auth-ok reply."""
+        key = _derive_key(self.secret)
+        client_nonce = _secrets.token_hex(16)
+        hello = self._exchange(_dumps({"op": "auth_hello", "nonce": client_nonce}))
+        result = hello.get("result") or {}
+        nonce = result.get("nonce")
+        if nonce is None:
+            # This client was configured with a secret; silently proceeding
+            # against a server that refuses to authenticate would hand every
+            # read AND write to whoever answered on this address (DNS/IP
+            # hijack, typoed port).  No downgrade.
+            self._close()
+            raise AuthenticationError(
+                f"server {self.host}:{self.port} does not require "
+                "authentication, but this client is configured with a "
+                "secret — refusing to proceed (remove the secret only if "
+                "you trust the network path)"
+            )
+        reply = self._exchange(
+            _dumps({"op": "auth", "mac": _mac(key, "client", client_nonce, nonce)})
+        )
+        if not reply.get("ok"):
+            self._close()
+            raise AuthenticationError(reply.get("message", "authentication failed"))
+        server_mac = str((reply.get("result") or {}).get("server_mac", ""))
+        if not hmac.compare_digest(
+            server_mac, _mac(key, "server", client_nonce, nonce)
+        ):
+            self._close()
+            raise AuthenticationError(
+                f"server {self.host}:{self.port} failed to prove knowledge of "
+                "the shared secret (impostor server, or mismatched secret files)"
+            )
 
     def _close(self):
         for closer in (self._file, self._sock):
@@ -249,7 +396,12 @@ class NetworkDB:
 
     def __getstate__(self):
         # Sockets don't cross fork/pickle; children reconnect lazily.
-        return {"host": self.host, "port": self.port, "timeout": self.timeout}
+        return {
+            "host": self.host,
+            "port": self.port,
+            "timeout": self.timeout,
+            "secret": self.secret,
+        }
 
     def __setstate__(self, state):
         self.__init__(**state)
@@ -310,6 +462,8 @@ class NetworkDB:
             raise DuplicateKeyError(message)
         if error == "KeyError":
             raise KeyError(message)
+        if error == "AuthenticationError":
+            raise AuthenticationError(message)
         raise DatabaseError(f"{error}: {message}")
 
     # --- AbstractDB contract --------------------------------------------------

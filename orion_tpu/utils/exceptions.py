@@ -34,6 +34,10 @@ class DuplicateKeyError(DatabaseError):
     """A unique-index constraint was violated on insert/update."""
 
 
+class AuthenticationError(DatabaseError):
+    """Network storage rejected the client's credentials (or none given)."""
+
+
 class FailedUpdate(DatabaseError):
     """A compare-and-swap update matched no document."""
 

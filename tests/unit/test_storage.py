@@ -28,9 +28,13 @@ def storage(request, tmp_path):
         return
     from orion_tpu.storage import DBServer
 
-    server = DBServer(port=0)
+    # The contract suite runs the network backend AUTHENTICATED, so every
+    # protocol op is exercised through the HMAC handshake path.
+    server = DBServer(port=0, secret="contract-secret")
     host, port = server.serve_background()
-    yield create_storage({"type": "network", "host": host, "port": port})
+    yield create_storage(
+        {"type": "network", "host": host, "port": port, "secret": "contract-secret"}
+    )
     server.shutdown()
     server.server_close()
 
@@ -294,7 +298,9 @@ def test_projection_preserves_dotted_keys_and_id_only():
 
 
 def _net_worker_reserve(host, port, out_queue):
-    storage = create_storage({"type": "network", "host": host, "port": port})
+    storage = create_storage(
+        {"type": "network", "host": host, "port": port, "secret": "mp-secret"}
+    )
     claimed = []
     while True:
         trial = storage.reserve_trial("exp-id")
@@ -305,14 +311,17 @@ def _net_worker_reserve(host, port, out_queue):
 
 
 def test_network_concurrent_reservation_across_processes():
-    """Multiple client processes against one server: every trial claimed
-    exactly once — the multi-node equivalent of the pickled flock test."""
+    """Multiple client processes against one AUTHENTICATED server: every
+    trial claimed exactly once — the multi-node equivalent of the pickled
+    flock test, with the HMAC handshake in every process."""
     from orion_tpu.storage import DBServer
 
-    server = DBServer(port=0)
+    server = DBServer(port=0, secret="mp-secret")
     host, port = server.serve_background()
     try:
-        storage = create_storage({"type": "network", "host": host, "port": port})
+        storage = create_storage(
+            {"type": "network", "host": host, "port": port, "secret": "mp-secret"}
+        )
         all_ids = set()
         for i in range(20):
             t = new_trial(i)
@@ -380,24 +389,86 @@ def test_network_duplicate_key_crosses_the_wire():
 
 
 def test_network_client_reconnects_after_server_restart(tmp_path):
+    """Reconnection re-runs the auth handshake transparently."""
     from orion_tpu.storage import DBServer, NetworkDB
 
     snapshot = str(tmp_path / "snap.pkl")
-    server = DBServer(port=0, persist=snapshot)
+    server = DBServer(port=0, persist=snapshot, secret="s3cret")
     host, port = server.serve_background()
-    db = NetworkDB(host=host, port=port)
+    db = NetworkDB(host=host, port=port, secret="s3cret")
     db.write("c", {"_id": 1, "v": 1})
     server.shutdown()
     server.server_close()
 
     # Restart on the SAME port so the same client handle keeps working.
-    server2 = DBServer(host=host, port=port, persist=snapshot)
+    server2 = DBServer(host=host, port=port, persist=snapshot, secret="s3cret")
     server2.serve_background()
     try:
         assert db.read("c", {"_id": 1})[0]["v"] == 1
     finally:
         server2.shutdown()
         server2.server_close()
+
+
+def test_network_auth_rejects_wrong_and_missing_secret():
+    """A wrong-secret client gets a clean AuthenticationError (not a
+    traceback or a hang); a no-secret client is rejected on its first op;
+    ping stays open for health checks."""
+    from orion_tpu.storage import DBServer, NetworkDB
+    from orion_tpu.utils.exceptions import AuthenticationError
+
+    server = DBServer(port=0, secret="right-secret")
+    host, port = server.serve_background()
+    try:
+        wrong = NetworkDB(host=host, port=port, secret="wrong-secret")
+        with pytest.raises(AuthenticationError):
+            wrong.read("c")
+        missing = NetworkDB(host=host, port=port)
+        assert missing.ping()  # health checks need no credentials
+        with pytest.raises(AuthenticationError):
+            missing.read("c")
+        # The right secret works on the very same server afterwards.
+        good = NetworkDB(host=host, port=port, secret="right-secret")
+        good.write("c", {"_id": 1, "v": 1})
+        assert good.read("c", {"_id": 1})[0]["v"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_network_auth_mismatched_secrets_fail_cleanly():
+    """Client and server with different secrets: clean AuthenticationError
+    at the handshake (client proves first, so the server rejects)."""
+    from orion_tpu.storage import DBServer, NetworkDB
+    from orion_tpu.utils.exceptions import AuthenticationError
+
+    server = DBServer(port=0, secret="server-side-secret")
+    host, port = server.serve_background()
+    try:
+        client = NetworkDB(host=host, port=port, secret="client-side-secret")
+        with pytest.raises(AuthenticationError, match="bad credentials"):
+            client.read("c")
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_network_auth_client_refuses_open_server_downgrade():
+    """A secret-configured client must NOT silently proceed against a
+    server that claims no auth (DNS hijack / typoed port would otherwise
+    hand all experiment data to whoever answered)."""
+    from orion_tpu.storage import DBServer, NetworkDB
+    from orion_tpu.utils.exceptions import AuthenticationError
+
+    server = DBServer(port=0)  # open server
+    host, port = server.serve_background()
+    try:
+        client = NetworkDB(host=host, port=port, secret="my-secret")
+        with pytest.raises(AuthenticationError, match="does not require"):
+            client.read("c")
+    finally:
+        server.shutdown()
+        server.server_close()
 
 
 def test_network_address_forms():
