@@ -220,11 +220,11 @@ def main_serve(args):
 
     secret = None
     if args.secret_file:
-        with open(args.secret_file) as handle:
-            secret = handle.read().strip()
-        if not secret:
-            print(f"ERROR: secret file {args.secret_file} is empty", file=sys.stderr)
-            return 1
+        # Same read-strip-validate (and clean error surface) as the client
+        # side's secret resolution.
+        from orion_tpu.storage.base import _resolve_network_secret
+
+        secret = _resolve_network_secret({"secret_file": args.secret_file})
     elif not args.no_auth:
         # Secure by default: binding 0.0.0.0 without credentials hands the
         # whole experiment to anyone on the network.

@@ -124,11 +124,10 @@ def test_console_entrypoint_runs():
     assert "orion-tpu" in out.stdout
 
 
-def test_hunt_without_script_on_new_experiment_fails_cleanly(tmp_path):
-    from orion_tpu.utils.exceptions import NoConfigurationError
-
-    with pytest.raises(NoConfigurationError):
-        cli_main(["hunt", "-n", "ghost", *storage_args(tmp_path), "--worker-trials", "1"])
+def test_hunt_without_script_on_new_experiment_fails_cleanly(tmp_path, capsys):
+    rc = cli_main(["hunt", "-n", "ghost", *storage_args(tmp_path), "--worker-trials", "1"])
+    assert rc == 1  # one-line error, not a traceback
+    assert "user script command is required" in capsys.readouterr().err
     # Nothing must have been persisted: the correct follow-up run starts clean.
     storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
     assert storage.fetch_experiments({"name": "ghost"}) == []

@@ -48,6 +48,102 @@ def test_graft_entry_single_chip_jit():
     assert out.shape == (8, 4)
 
 
+_TWO_PROC_SCRIPT = """
+import os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from orion_tpu.parallel import init_distributed, device_mesh, candidate_sharding
+init_distributed(coordinator=f"localhost:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8 and len(jax.local_devices()) == 4
+
+# 1) A collective that MUST cross the process boundary: sum a global array
+# sharded over the 8-device mesh (4 devices live in the other process).
+import jax.numpy as jnp
+import numpy as np
+mesh = device_mesh()
+sharding = candidate_sharding(mesh)
+global_shape = (8, 2)
+arr = jax.make_array_from_callback(
+    global_shape, sharding,
+    lambda idx: np.ones(global_shape, np.float32)[idx] * (1 + np.arange(8)[idx[0]])[:, None],
+)
+total = jax.jit(lambda a: jnp.sum(a), out_shardings=None)(arr)
+# sum over rows (1+...+8) * 2 cols = 72; identical in both processes.
+print("PSUM", float(total), flush=True)
+
+# 2) The real sharded suggest step over the GLOBAL mesh, both processes
+# executing the same program (SPMD): outputs must be identical.
+from orion_tpu.algo.base import create_algo
+from orion_tpu.space.dsl import build_space
+space = build_space({f"x{i}": "uniform(0, 1)" for i in range(3)})
+algo = create_algo(space, {"tpu_bo": {"n_init": 4, "n_candidates": 256,
+                                       "fit_steps": 5, "use_mesh": True}}, seed=0)
+params = space.sample(0, n=8)
+algo.observe(params, [{"objective": float(v)}
+                      for v in np.random.default_rng(0).normal(size=8)])
+out = algo.suggest(4)
+assert len(out) == 4
+canon = [[round(float(p[k]), 6) for k in sorted(p)] for p in out]
+print("RESULT", canon, flush=True)
+print("COHORT2-OK", flush=True)
+"""
+
+
+def test_init_distributed_two_process_cohort():
+    """VERDICT r2 #5: a cross-process collective actually executes.  Two
+    subprocesses form a jax.distributed CPU cohort (4 virtual devices
+    each), build the global 8-device mesh, reduce a globally-sharded array
+    (data lives in BOTH processes), and run the mesh-sharded suggest step
+    SPMD — asserting both processes produce identical suggestions."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["ORION_TPU_JIT_CACHE"] = "off"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _TWO_PROC_SCRIPT, str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            assert p.returncode == 0, stderr[-2000:]
+            assert "COHORT2-OK" in stdout, stdout
+            outs.append(stdout)
+    finally:
+        # A hang/failure in one process must not leak the other for the
+        # rest of the pytest run (it blocks on the cohort coordinator).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    lines = [
+        {ln.split(" ", 1)[0]: ln.split(" ", 1)[1] for ln in out.splitlines()
+         if ln.startswith(("PSUM", "RESULT"))}
+        for out in outs
+    ]
+    # The reduction saw rows from both processes: (1+..+8)*2 = 72.
+    assert float(lines[0]["PSUM"]) == 72.0
+    assert lines[0]["PSUM"] == lines[1]["PSUM"]
+    # SPMD: both processes computed the identical suggestion batch.
+    assert lines[0]["RESULT"] == lines[1]["RESULT"]
+
+
 def test_init_distributed_single_process_cohort():
     """init_distributed forms a 1-process cohort and the mesh-sharded
     suggest step runs under it.  Subprocess: jax.distributed binds global
