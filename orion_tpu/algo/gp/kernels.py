@@ -42,10 +42,17 @@ def matern52(xa, xb, inv_lengthscales, amplitude):
 
 _KERNELS = {"rbf": rbf, "matern52": matern52}
 
-# Crossover measured on TPU v5e: the fused pallas gram beats XLA once the
-# (m, n) intermediate is big enough that its HBM round-trip dominates
-# (~5x at 16384x1024xd=50); below this XLA's fusion is already optimal.
-_PALLAS_MIN_WORK = 2 * 10**8
+# Measured head-to-head on the real chip with the two-chain-length method
+# (`python -m orion_tpu.benchmarks.runner --op gram`: per-op time =
+# (t_1032ops - t_8ops)/1024 per dispatch, cancelling the ~75 ms tunnel
+# round trip exactly; gram consumed by a matvec + elementwise-square
+# reduction like the production posterior; table in docs/performance.md):
+# the fused pallas gram wins 1.1-1.4x over XLA on every production shape,
+# including the smallest (m=4096, n=256, d=8 -> work 8.4e6).  Round 2's
+# "~5x" and an interim "parity" conclusion were both artifacts of
+# tunnel-latency-dominated timing.  The threshold covers every shape
+# measured to win; below it the dispatch is untested and XLA is kept.
+_PALLAS_MIN_WORK = 8 * 10**6
 
 
 def kernel_matrix(kind, xa, xb, inv_lengthscales, amplitude):
@@ -54,14 +61,18 @@ def kernel_matrix(kind, xa, xb, inv_lengthscales, amplitude):
 
 def cross_kernel_matrix(kind, xa, xb, inv_lengthscales, amplitude):
     """Forward-only gram for candidate scoring: dispatches to the pallas
-    fused kernel (`orion_tpu.ops.fused_gram`) on large shapes.  Never use
-    under `jax.grad` — the pallas path defines no autodiff rule (the MLL
-    fit's (n, n) kernel stays on `kernel_matrix`)."""
+    fused kernel (`orion_tpu.ops.fused_gram`) on measured-to-win shapes
+    when the runtime's compile/run probe passes (ORION_TPU_PALLAS=0 opts
+    out — see _PALLAS_MIN_WORK note).  Never use under `jax.grad` — the
+    pallas path defines no autodiff rule (the MLL fit's (n, n) kernel
+    stays on `kernel_matrix`)."""
     m, d = xa.shape
     n = xb.shape[0]
     if m * n * max(d, 1) >= _PALLAS_MIN_WORK:
-        from orion_tpu.ops import fused_gram, pallas_available
+        from orion_tpu.ops import pallas_enabled
 
-        if pallas_available():
+        if pallas_enabled():
+            from orion_tpu.ops import fused_gram
+
             return fused_gram(xa, xb, inv_lengthscales, amplitude, kind=kind)
     return _KERNELS[kind](xa, xb, inv_lengthscales, amplitude)

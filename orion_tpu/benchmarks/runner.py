@@ -168,7 +168,21 @@ def run_preset(name, seed=0, **overrides):
 def main(argv=None):
     import sys
 
-    names = (argv if argv is not None else sys.argv[1:]) or list(PRESETS)
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if "--op" in argv:
+        at = argv.index("--op")
+        op = argv[at + 1] if at + 1 < len(argv) else None
+        if op != "gram":
+            print(
+                f"unknown --op {op!r}; available micro-benchmarks: gram",
+                file=sys.stderr,
+            )
+            raise SystemExit(2)
+        from orion_tpu.benchmarks.gram_bench import run_gram_bench
+
+        run_gram_bench()
+        return
+    names = argv or list(PRESETS)
     for name in names:
         print(json.dumps(run_preset(name)))
 

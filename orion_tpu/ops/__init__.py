@@ -7,6 +7,6 @@ with the Matern/RBF epilogue so the (m, n) intermediate never round-trips
 through HBM.
 """
 
-from orion_tpu.ops.gram import fused_gram, pallas_available
+from orion_tpu.ops.gram import fused_gram, pallas_available, pallas_enabled
 
-__all__ = ["fused_gram", "pallas_available"]
+__all__ = ["fused_gram", "pallas_available", "pallas_enabled"]

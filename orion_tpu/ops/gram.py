@@ -100,17 +100,19 @@ def fused_gram(xa, xb, inv_lengthscales, amplitude, *, kind="matern52", interpre
     return out[:m, :n]
 
 
-@functools.lru_cache(maxsize=1)
-def pallas_available():
-    """True when the fused gram actually compiles and runs on the default
-    backend (Mosaic support varies across TPU runtimes; CPU/GPU interpret
-    mode is for tests, not production dispatch).
-
-    Override with ORION_TPU_PALLAS=1/0.
-    """
+def _env_opt_in():
+    """ORION_TPU_PALLAS as a tri-state: True / False / None (unset)."""
     forced = os.environ.get("ORION_TPU_PALLAS", "").strip()
-    if forced:  # set-but-empty means unset: fall through to autodetection
-        return forced.lower() not in ("0", "false", "no", "off")
+    if not forced:  # set-but-empty means unset
+        return None
+    return forced.lower() not in ("0", "false", "no", "off")
+
+
+@functools.lru_cache(maxsize=1)
+def _probe():
+    """Does the fused gram actually compile AND run on the default backend?
+    (Mosaic support varies across TPU runtimes; CPU/GPU interpret mode is
+    for tests, not production dispatch.)"""
     if jax.default_backend() not in ("tpu",):
         return False
     try:
@@ -119,3 +121,26 @@ def pallas_available():
         return bool(np.isfinite(np.asarray(out)).all())
     except Exception:  # pragma: no cover - backend-specific lowering failures
         return False
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_available():
+    """True when the fused gram can run here; ORION_TPU_PALLAS=1/0
+    overrides autodetection (tests force both branches on CPU)."""
+    forced = _env_opt_in()
+    if forced is not None:
+        return forced
+    return _probe()
+
+
+@functools.lru_cache(maxsize=1)
+def pallas_enabled():
+    """Should the GP engine DISPATCH to the fused gram?  Auto-enabled when
+    the compile/run probe passes: the dispatch-amortized micro-bench
+    (`--op gram`, docs/performance.md) measures the fused kernel 1.1-1.4x
+    over XLA on every production shape.  ORION_TPU_PALLAS=0 opts out;
+    ORION_TPU_PALLAS=1 cannot force dispatch past a FAILING probe — the
+    env var must never push Mosaic lowering errors into the suggest path."""
+    if _env_opt_in() is False:
+        return False
+    return _probe()
