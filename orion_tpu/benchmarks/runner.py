@@ -170,17 +170,19 @@ def main(argv=None):
 
     argv = list(argv if argv is not None else sys.argv[1:])
     if "--op" in argv:
-        at = argv.index("--op")
-        op = argv[at + 1] if at + 1 < len(argv) else None
-        if op != "gram":
-            print(
-                f"unknown --op {op!r}; available micro-benchmarks: gram",
-                file=sys.stderr,
-            )
-            raise SystemExit(2)
+        import argparse
+
+        parser = argparse.ArgumentParser(prog="orion_tpu.benchmarks.runner")
+        parser.add_argument("--op", choices=["gram"], required=True)
+        parser.add_argument("--kind", default="matern52",
+                            choices=["matern52", "rbf"])
+        parser.add_argument("--reps", type=int, default=8)
+        # parse_args errors out loudly on leftover preset names — a user
+        # combining both must not believe the presets silently ran.
+        args = parser.parse_args(argv)
         from orion_tpu.benchmarks.gram_bench import run_gram_bench
 
-        run_gram_bench()
+        run_gram_bench(kind=args.kind, reps=args.reps)
         return
     names = argv or list(PRESETS)
     for name in names:

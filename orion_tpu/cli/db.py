@@ -23,18 +23,7 @@ def add_subparser(subparsers):
     sub = parser.add_subparsers(dest="db_command", metavar="ACTION")
 
     setup_p = sub.add_parser("setup", help="write the user configuration file")
-    setup_p.add_argument(
-        "--storage-type",
-        default="pickled",
-        choices=["pickled", "sqlite", "memory", "network"],
-    )
-    setup_p.add_argument("--path", default=None, help="DB file path (pickled/sqlite)")
-    setup_p.add_argument("--host", default="127.0.0.1", help="network DB host")
-    setup_p.add_argument("--port", type=int, default=8765, help="network DB port")
-    setup_p.add_argument(
-        "--secret-file", default=None,
-        help="shared-secret file for an authenticated network server",
-    )
+    add_setup_args(setup_p)
     setup_p.set_defaults(func=main_setup)
 
     serve_p = sub.add_parser(
@@ -94,6 +83,23 @@ def _common(parser):
     parser.add_argument("-c", "--config", metavar="path", default=None)
     parser.add_argument("--storage-path", default=None)
     parser.add_argument("--debug", action="store_true")
+
+
+def add_setup_args(parser):
+    """Storage-setup arguments, shared by `db setup` and the top-level
+    `setup` alias."""
+    parser.add_argument(
+        "--storage-type",
+        default="pickled",
+        choices=["pickled", "sqlite", "memory", "network"],
+    )
+    parser.add_argument("--path", default=None, help="DB file path (pickled/sqlite)")
+    parser.add_argument("--host", default="127.0.0.1", help="network DB host")
+    parser.add_argument("--port", type=int, default=8765, help="network DB port")
+    parser.add_argument(
+        "--secret-file", default=None,
+        help="shared-secret file for an authenticated network server",
+    )
 
 
 def _copy_spec_to_config(spec):

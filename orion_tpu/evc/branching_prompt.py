@@ -27,14 +27,18 @@ class BranchingPrompt(cmd.Cmd):
     # --- inspection -----------------------------------------------------------
     def do_status(self, _line):
         """List conflicts and their resolution state."""
+        from orion_tpu.utils.diff import colorize_diff_line
+
         for conflict in self.builder.conflicts.conflicts:
             mark = "resolved" if conflict.is_resolved else "PENDING "
-            print(f"  [{mark}] {conflict.diff()}")
+            print(f"  [{mark}] {colorize_diff_line(conflict.diff())}")
 
     def do_diff(self, _line):
-        """Print the configuration diff."""
+        """Print the configuration diff (colored on a TTY)."""
+        from orion_tpu.utils.diff import colorize_diff_line
+
         for line in self.builder.conflicts.diffs():
-            print(" ", line)
+            print(" ", colorize_diff_line(line))
 
     # --- resolutions ----------------------------------------------------------
     def do_name(self, line):

@@ -14,12 +14,25 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 BLACK_BOX = os.path.join(HERE, "black_box.py")
 
 
-@pytest.fixture
-def populated(tmp_path):
-    db = ["--storage-path", str(tmp_path / "db.pkl")]
-    cli_main(["hunt", "-n", "cmd-exp", *db, "--max-trials", "4", "--worker-trials", "4",
+@pytest.fixture(scope="module")
+def _populated_template(tmp_path_factory):
+    """Run the 4-trial hunt ONCE per module; ~10s of subprocess trials that
+    eight tests each paid before this was a template."""
+    root = tmp_path_factory.mktemp("populated-template")
+    cli_main(["hunt", "-n", "cmd-exp", "--storage-path", str(root / "db.pkl"),
+              "--max-trials", "4", "--worker-trials", "4",
               BLACK_BOX, "-x~uniform(-50, 50)"])
-    return tmp_path, db
+    return root / "db.pkl"
+
+
+@pytest.fixture
+def populated(_populated_template, tmp_path):
+    """Per-test COPY of the template DB: mutating tests (insert, resume,
+    branching hunts) keep full isolation at file-copy cost."""
+    import shutil
+
+    shutil.copy(_populated_template, tmp_path / "db.pkl")
+    return tmp_path, ["--storage-path", str(tmp_path / "db.pkl")]
 
 
 def test_info(populated, capsys):
@@ -122,6 +135,43 @@ def test_db_setup_writes_user_config(tmp_path, monkeypatch, capsys):
     data = yaml.safe_load(path.read_text())
     assert data["storage"]["type"] == "pickled"
     assert data["storage"]["path"] == str(tmp_path / "mydb.pkl")
+
+
+def test_setup_and_test_db_top_level_aliases(tmp_path, monkeypatch, capsys):
+    """`setup` and `test-db` mirror `db setup` / `db test` (reference
+    `cli/setup.py`, `cli/test_db.py` historical spellings)."""
+    monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "cfg"))
+    assert cli_main(["setup", "--storage-type", "sqlite",
+                     "--path", str(tmp_path / "a.sqlite")]) == 0
+    import yaml
+
+    data = yaml.safe_load(
+        (tmp_path / "cfg" / "orion_tpu" / "config.yaml").read_text()
+    )
+    assert data["storage"]["type"] == "sqlite"
+    assert cli_main(["test-db"]) == 0
+    out = capsys.readouterr().out
+    assert "check presence... ok" in out
+    assert "check operations... ok" in out
+
+
+def test_branching_diff_lines_colorize_on_tty(monkeypatch):
+    import io
+
+    from orion_tpu.utils.diff import colorize_diff_line
+
+    class Tty(io.StringIO):
+        def isatty(self):
+            return True
+
+    monkeypatch.delenv("NO_COLOR", raising=False)  # ambient CI shells set it
+    assert colorize_diff_line("+ x~uniform(0,1)", stream=Tty()).startswith("\x1b[0;32m")
+    assert colorize_diff_line("- y~uniform(0,1)", stream=Tty()).startswith("\x1b[0;31m")
+    assert colorize_diff_line("~ z: a -> b", stream=Tty()).startswith("\x1b[0;33m")
+    # Non-TTY (scripted sessions, tests) and NO_COLOR stay plain.
+    assert colorize_diff_line("+ x", stream=io.StringIO()) == "+ x"
+    monkeypatch.setenv("NO_COLOR", "1")
+    assert colorize_diff_line("+ x", stream=Tty()) == "+ x"
 
 
 def test_resume_preserves_stored_budgets(populated, capsys):

@@ -71,26 +71,25 @@ def test_pallas_available_env_override(monkeypatch):
 def test_pallas_dispatch_policy(monkeypatch):
     """Dispatch follows the compile/run probe (auto-enable where the fused
     kernel measured 1.1-1.4x, docs/performance.md): ORION_TPU_PALLAS=0
-    disables, and =1 cannot force dispatch past a FAILING probe — this CPU
-    test mesh is exactly such a runtime, so dispatch must stay off in
-    every configuration here."""
-    from orion_tpu.ops.gram import _probe, pallas_enabled
+    disables, and =1 cannot force dispatch past a FAILING probe.  The probe
+    is stubbed both ways so the policy is asserted identically on the CPU
+    test mesh and on real hardware (ORION_TPU_TEST_PLATFORM=axon)."""
+    import orion_tpu.ops.gram as gram
 
     def reset():
-        pallas_enabled.cache_clear()
-        pallas_available.cache_clear()
-        _probe.cache_clear()
+        gram.pallas_enabled.cache_clear()
+        gram.pallas_available.cache_clear()
 
-    reset()
-    monkeypatch.delenv("ORION_TPU_PALLAS", raising=False)
-    assert pallas_enabled() is False  # probe fails on CPU
-    reset()
-    monkeypatch.setenv("ORION_TPU_PALLAS", "1")
-    # env=1 on a CPU mesh: pallas_available reports the override (tests
-    # exercise both branches with it) but dispatch still refuses.
-    assert pallas_available() is True
-    assert pallas_enabled() is False
-    reset()
-    monkeypatch.setenv("ORION_TPU_PALLAS", "0")
-    assert pallas_enabled() is False
-    reset()
+    for probe_ok in (False, True):
+        monkeypatch.setattr(gram, "_probe", lambda ok=probe_ok: ok)
+        reset()
+        monkeypatch.delenv("ORION_TPU_PALLAS", raising=False)
+        assert gram.pallas_enabled() is probe_ok  # auto-follows the probe
+        reset()
+        monkeypatch.setenv("ORION_TPU_PALLAS", "0")
+        assert gram.pallas_enabled() is False  # explicit opt-out always wins
+        reset()
+        monkeypatch.setenv("ORION_TPU_PALLAS", "1")
+        assert gram.pallas_enabled() is probe_ok  # cannot force a failing probe
+        assert gram.pallas_available() is True  # ...though tests may override
+        reset()
