@@ -46,9 +46,12 @@ def build_parser():
 
 
 def main(argv=None):
-    argv = argv if argv is not None else sys.argv[1:]
+    argv = list(argv if argv is not None else sys.argv[1:])
     parser = build_parser()
     args = parser.parse_args(argv)
+    # Raw argv: commands that re-spawn themselves (hunt --n-workers) need
+    # the exact invocation, not a reconstruction from parsed args.
+    args._argv = argv
     level = {0: logging.WARNING, 1: logging.INFO}.get(args.verbose, logging.DEBUG)
     logging.basicConfig(level=level, format="%(levelname)s %(name)s: %(message)s")
     if not getattr(args, "func", None):

@@ -38,6 +38,14 @@ def add_subparser(subparsers):
         help="seconds the producer may go without registering a new point",
     )
     group.add_argument(
+        "--n-workers",
+        type=int,
+        default=1,
+        help="run this many asynchronous workers against the shared storage "
+        "(this process plus N-1 spawned ones; same semantics as launching "
+        "the identical hunt command N times)",
+    )
+    group.add_argument(
         "--profile",
         metavar="DIR",
         default=None,
@@ -48,9 +56,40 @@ def add_subparser(subparsers):
     return parser
 
 
-def main(args):
-    experiment, parser = build_from_args(args)
-    experiment.instantiate()
+# Children must never re-spawn.  Argv surgery is unsound both ways: flag
+# stripping misses argparse prefix abbreviations (--n-worker), and an
+# appended override lands inside the user_args REMAINDER, so the child
+# still parses the original count — either way a fork bomb.  An env
+# sentinel is immune to every argv form and leaves user args untouched.
+_SPAWNED_ENV = "ORION_TPU_SPAWNED_WORKER"
+
+
+def _spawn_workers(args, experiment):
+    """N-1 child processes running the identical hunt (the reference's
+    'submit the same command N times' cluster recipe, built in).  The
+    experiment is built/branched BEFORE spawning so children resume it."""
+    from orion_tpu.storage.documents import MemoryDB
+    from orion_tpu.utils.exceptions import CheckError
+
+    if isinstance(getattr(experiment.storage, "db", None), MemoryDB):
+        raise CheckError(
+            "--n-workers needs storage processes can share (--storage-path "
+            "file, sqlite, or a network server); in-memory storage is "
+            "per-process."
+        )
+    import os
+    import subprocess
+
+    argv = list(getattr(args, "_argv", []) or [])
+    env = dict(os.environ)
+    env[_SPAWNED_ENV] = "1"
+    return [
+        subprocess.Popen([sys.executable, "-m", "orion_tpu.cli", *argv], env=env)
+        for _ in range(args.n_workers - 1)
+    ]
+
+
+def _run_worker(experiment, parser, args):
     profile_dir = getattr(args, "profile", None)
     if profile_dir:
         import jax
@@ -66,14 +105,41 @@ def main(args):
             # trials get recovered as lost.
             heartbeat_interval=experiment.heartbeat / 2.0,
         )
-    except BrokenExperiment as exc:
-        print(f"Error: {exc}", file=sys.stderr)
-        return 1
     finally:
         if profile_dir:
             import jax
 
             jax.profiler.stop_trace()
             print(f"jax profiler trace written to {profile_dir}", file=sys.stderr)
+
+
+def main(args):
+    import os
+
+    experiment, parser = build_from_args(args)
+    experiment.instantiate()
+    workers = []
+    if getattr(args, "n_workers", 1) > 1 and not os.environ.get(_SPAWNED_ENV):
+        workers = _spawn_workers(args, experiment)
+    try:
+        try:
+            _run_worker(experiment, parser, args)
+        except BrokenExperiment as exc:
+            print(f"Error: {exc}", file=sys.stderr)
+            # Children hit the same broken budget and stop on their own.
+            for proc in workers:
+                proc.wait()
+            return 1
+    except BaseException:
+        # Any other parent failure (storage errors, Ctrl-C): the cohort
+        # must not be orphaned to keep consuming the budget in the
+        # background after the command "exited".
+        for proc in workers:
+            proc.terminate()
+        for proc in workers:
+            proc.wait()
+        raise
+    # Stats must reflect the WHOLE cohort's work, so join children first.
+    failed = any(proc.wait() != 0 for proc in workers)
     print(format_stats(experiment))
-    return 0
+    return 1 if failed else 0

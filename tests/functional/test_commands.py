@@ -137,6 +137,32 @@ def test_db_setup_writes_user_config(tmp_path, monkeypatch, capsys):
     assert data["storage"]["path"] == str(tmp_path / "mydb.pkl")
 
 
+def test_hunt_n_workers_shares_the_budget(tmp_path, capsys):
+    """--n-workers N spawns N-1 identical child hunts against the shared
+    storage; the cohort completes the global budget exactly once."""
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    rc = cli_main(["hunt", "-n", "nw", *db, "--max-trials", "8",
+                   "--n-workers", "2", "--working-dir", str(tmp_path / "w"),
+                   BLACK_BOX, "-x~uniform(-5, 5)"])
+    assert rc == 0
+    assert "trials completed:" in capsys.readouterr().out
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    [exp] = storage.fetch_experiments({"name": "nw"})
+    trials = storage.fetch_trials(uid=exp["_id"])
+    completed = sum(1 for t in trials if t.status == "completed")
+    # Async workers check is_done before consuming, so a final in-flight
+    # trial per extra worker may land past the budget — same soft-budget
+    # semantics as N manually-launched hunts (and the reference).
+    assert 8 <= completed <= 9
+
+
+def test_hunt_n_workers_refuses_memory_storage(capsys):
+    rc = cli_main(["hunt", "-n", "nwm", "--debug", "--max-trials", "2",
+                   "--n-workers", "2", BLACK_BOX, "-x~uniform(-5, 5)"])
+    assert rc == 1
+    assert "in-memory storage is per-process" in capsys.readouterr().err
+
+
 def test_setup_and_test_db_top_level_aliases(tmp_path, monkeypatch, capsys):
     """`setup` and `test-db` mirror `db setup` / `db test` (reference
     `cli/setup.py`, `cli/test_db.py` historical spellings)."""
