@@ -81,6 +81,14 @@ def _spawn_workers(args, experiment):
     import subprocess
 
     argv = list(getattr(args, "_argv", []) or [])
+    if not argv:
+        # Programmatic callers building args by hand have no invocation to
+        # replay; spawning bare children would print help and "fail".
+        raise CheckError(
+            "--n-workers requires the CLI invocation (argv) to replay in "
+            "child processes; call through orion_tpu.cli.main, or launch "
+            "workers yourself."
+        )
     env = dict(os.environ)
     env[_SPAWNED_ENV] = "1"
     return [
@@ -139,7 +147,12 @@ def main(args):
         for proc in workers:
             proc.wait()
         raise
-    # Stats must reflect the WHOLE cohort's work, so join children first.
-    failed = any(proc.wait() != 0 for proc in workers)
-    print(format_stats(experiment))
-    return 1 if failed else 0
+    # Stats must reflect the WHOLE cohort's work, so join EVERY child first
+    # (a list, not a short-circuiting any(): stragglers would outlive the
+    # command and keep consuming budget).
+    codes = [proc.wait() for proc in workers]
+    if not os.environ.get(_SPAWNED_ENV):
+        # Only the parent reports; N interleaved copies of the same stats
+        # block from the children would drown the terminal.
+        print(format_stats(experiment))
+    return 1 if any(code != 0 for code in codes) else 0

@@ -73,7 +73,20 @@ class GenericConverter:
     """
 
     extensions = ()
-    PRIOR_RE = re.compile(r"([\w\.\-/]+)~([^\s'\"]+)")
+    # Expression alternatives, first match wins: a (possibly marked) call
+    # form whose parentheses may contain spaces/quotes
+    # (``lr~loguniform(1e-4, 1e-1)``, ``act~+choices(['relu', 'tanh'])``),
+    # the remove marker ``x~-``, the rename marker ``x~>new_name``, or a
+    # bare token.  Truncating at whitespace (the previous rule) silently
+    # dropped everything after the first space inside the parentheses —
+    # the reference's regex (`convert.py:158`) deliberately spans to the
+    # closing parenthesis for the same reason.
+    # The marker alternatives need boundaries: a bare "-" must not eat the
+    # front of "-5" (old bare-token capture), and ">name" must span
+    # hyphenated names or "m~>new-name" would template a dangling "-name".
+    PRIOR_RE = re.compile(
+        r"([\w\.\-/]+)~([+]?[\w.]+\([^)]*\)|-(?![\w.\-])|>[\w.\-]+|[^\s'\"]+)"
+    )
 
     def __init__(self):
         self._template = None
