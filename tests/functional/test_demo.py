@@ -157,14 +157,19 @@ def _run_network_worker(conf_path, name):
 def test_two_workers_one_network_server(tmp_path):
     """The multi-NODE story: two worker processes coordinate through one
     `orion-tpu db serve` server over TCP (reference's MongoDB deployment,
-    docs/src/examples/cluster.rst — N hunts against one networked DB)."""
+    docs/src/examples/cluster.rst — N hunts against one networked DB),
+    with shared-secret authentication on, end to end through the config
+    file — the documented production deployment."""
     from orion_tpu.storage import DBServer
 
-    server = DBServer(port=0)
+    secret_file = tmp_path / "sweep.secret"
+    secret_file.write_text("functional-sweep-secret\n")
+    server = DBServer(port=0, secret="functional-sweep-secret")
     host, port = server.serve_background()
     conf = tmp_path / "conf.yaml"
     conf.write_text(
         f"storage:\n  type: network\n  host: {host}\n  port: {port}\n"
+        f"  secret_file: {secret_file}\n"
     )
     try:
         ctx = multiprocessing.get_context("spawn")
@@ -177,7 +182,10 @@ def test_two_workers_one_network_server(tmp_path):
         for w in workers:
             w.join(timeout=240)
             assert w.exitcode == 0
-        storage = create_storage({"type": "network", "host": host, "port": port})
+        storage = create_storage(
+            {"type": "network", "host": host, "port": port,
+             "secret_file": str(secret_file)}
+        )
         exps = storage.fetch_experiments({"name": "netpair"})
         assert len(exps) == 1
         completed = [
