@@ -65,7 +65,9 @@ def _storage_type_for_path(path):
 
 
 def load_cli_config(args):
-    """Merge config sources: defaults < env < config file < cmdline."""
+    """Merge config sources: defaults < env < config file < cmdline.
+    Sectioned spellings (`experiment:`, `producer:`, `database:`) are
+    normalized inside resolve_config — for every file layer, not just -c."""
     file_config = {}
     if getattr(args, "config", None):
         with open(args.config) as handle:

@@ -21,13 +21,42 @@ def user_config_path():
     return os.path.join(base, "orion_tpu", "config.yaml")
 
 
+def normalize_sections(cfg):
+    """Accept sectioned config-file spellings alongside the canonical
+    top-level keys, instead of silently ignoring them (a config whose
+    `algorithms:` sits under an `experiment:` section otherwise runs
+    RANDOM search without a word).  Applied to EVERY file layer — the
+    user-level config.yaml is exactly where reference users keep their
+    `database:` section:
+
+    - ``experiment:`` — everything inside is hoisted to top level;
+      explicit top-level keys win (shallow: the top-level value replaces
+      the sectioned one whole);
+    - ``producer: strategy:`` — the reference's spelling for the parallel
+      strategy (`tests/functional/algos/asha_config.yaml` layout);
+    - ``database:`` — the reference's storage section; create_storage
+      already understands the reference's type aliases (pickleddb,
+      ephemeraldb)."""
+    cfg = dict(cfg)
+    nested = cfg.pop("experiment", None)
+    if isinstance(nested, dict):
+        cfg = {**nested, **cfg}
+    producer = cfg.pop("producer", None)
+    if isinstance(producer, dict) and "strategy" in producer:
+        cfg.setdefault("strategy", producer["strategy"])
+    database = cfg.pop("database", None)
+    if isinstance(database, dict):
+        cfg.setdefault("storage", database)
+    return cfg
+
+
 def _user_file_config():
     path = user_config_path()
     if not os.path.exists(path):
         return {}
     try:
         with open(path) as handle:
-            return yaml.safe_load(handle) or {}
+            return normalize_sections(yaml.safe_load(handle) or {})
     except Exception:  # pragma: no cover - malformed user config
         return {}
 
@@ -104,7 +133,11 @@ def merge_configs(*configs):
 def resolve_config(file_config=None, cmd_config=None, storage_override=None):
     """defaults < user config file < env < -c config file < cmdline."""
     config = merge_configs(
-        DEFAULTS, _user_file_config(), _env_config(), file_config, cmd_config
+        DEFAULTS,
+        _user_file_config(),
+        _env_config(),
+        normalize_sections(file_config or {}),
+        cmd_config,
     )
     if storage_override:
         config["storage"] = storage_override

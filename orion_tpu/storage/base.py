@@ -409,7 +409,7 @@ def create_storage(config=None):
     """
     config = dict(config or {})
     db_type = config.get("type", "pickled")
-    if db_type in ("memory", "ephemeral"):
+    if db_type in ("memory", "ephemeral", "ephemeraldb"):
         return DocumentStorage(MemoryDB())
     if db_type in ("pickled", "pickleddb"):
         path = config.get("path", "orion_tpu_db.pkl")

@@ -137,6 +137,63 @@ def test_db_setup_writes_user_config(tmp_path, monkeypatch, capsys):
     assert data["storage"]["path"] == str(tmp_path / "mydb.pkl")
 
 
+def test_sectioned_config_files_are_not_silently_ignored(tmp_path, capsys):
+    """`experiment:`-wrapped keys and the reference's `producer:`/`database:`
+    sections must configure the run — a config whose algorithms sat under
+    `experiment:` previously ran RANDOM search without a word."""
+    conf = tmp_path / "exp.yaml"
+    conf.write_text(
+        "experiment:\n"
+        "  algorithms:\n"
+        "    grid_search:\n"
+        "      n_values: 3\n"
+        "producer:\n"
+        "  strategy: StubParallelStrategy\n"
+        f"database:\n  type: pickleddb\n  path: {tmp_path / 'ref.pkl'}\n"
+    )
+    rc = cli_main(["hunt", "-n", "sect", "-c", str(conf), "--max-trials", "3",
+                   "--working-dir", str(tmp_path / "w"),
+                   BLACK_BOX, "-x~uniform(-5, 5)"])
+    assert rc == 0
+    capsys.readouterr()
+    # The database: section routed storage to the reference-style pickleddb
+    # path, and the experiment: section selected grid_search.
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "ref.pkl")})
+    [exp] = storage.fetch_experiments({"name": "sect"})
+    assert "grid_search" in exp["algorithms"]
+    rc = cli_main(["info", "-n", "sect", "-c", str(conf)])
+    assert rc == 0
+    assert "grid_search" in capsys.readouterr().out
+
+
+def test_sectioned_user_level_config(tmp_path, monkeypatch):
+    """The ~/.config user file layer normalizes sections too — that is
+    exactly where reference users keep their `database:` section."""
+    cfg_dir = tmp_path / "xdg" / "orion_tpu"
+    cfg_dir.mkdir(parents=True)
+    (cfg_dir / "config.yaml").write_text(
+        f"database:\n  type: pickleddb\n  path: {tmp_path / 'user.pkl'}\n"
+    )
+    monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "xdg"))
+    from orion_tpu.config import resolve_config
+
+    config = resolve_config()
+    assert config["storage"]["type"] == "pickleddb"
+    assert config["storage"]["path"] == str(tmp_path / "user.pkl")
+
+
+def test_sectioned_config_top_level_wins():
+    """experiment:-hoisted keys lose WHOLE to explicit top-level ones
+    (shallow replace): never a merged two-algorithm dict create_algo
+    would reject."""
+    from orion_tpu.config import normalize_sections
+
+    cfg = normalize_sections(
+        {"experiment": {"algorithms": {"tpe": {}}}, "algorithms": {"random": {}}}
+    )
+    assert cfg["algorithms"] == {"random": {}}
+
+
 def test_hunt_n_workers_shares_the_budget(tmp_path, capsys):
     """--n-workers N spawns N-1 identical child hunts against the shared
     storage; the cohort completes the global budget exactly once."""
