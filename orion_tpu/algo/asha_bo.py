@@ -34,6 +34,7 @@ from orion_tpu.algo.tpu_bo import (
     run_suggest_step,
     tr_update,
 )
+from orion_tpu.parallel import device_mesh
 
 log = logging.getLogger(__name__)
 
@@ -73,6 +74,8 @@ class ASHABO(ASHA):
         tr_improve_tol=1e-3,
         tr_local_m=512,
         tr_perturb_dims=20,
+        n_devices=None,
+        use_mesh=False,
     ):
         super().__init__(
             space,
@@ -112,6 +115,11 @@ class ASHABO(ASHA):
         self.tr_improve_tol = tr_improve_tol
         self.tr_local_m = tr_local_m
         self.tr_perturb_dims = tr_perturb_dims
+        # Same mesh semantics as TPUBO: shard the candidate axis of the fused
+        # suggest step over the devices (BASELINE config #5 names q=4096 on a
+        # v5e-8 — the model-based variant must scale the same way).
+        self.use_mesh = use_mesh
+        self._mesh = device_mesh(n_devices) if use_mesh else None
         self._tr_length = tr_length_init
         self._tr_succ = 0
         self._tr_fail = 0
@@ -133,8 +141,9 @@ class ASHABO(ASHA):
         self._best_seen = np.inf
 
     # Naive-copy sharing (base __deepcopy__): the fitted GP state
-    # (n_pad x n_pad Cholesky) and the append-only observation arrays.
-    _share_by_ref = ("space", "_gp_state", "_mf_x", "_mf_s", "_mf_y")
+    # (n_pad x n_pad Cholesky), the append-only observation arrays, and the
+    # (uncopyable) mesh handle.
+    _share_by_ref = ("space", "_gp_state", "_mf_x", "_mf_s", "_mf_y", "_mesh")
 
     # --- observation ---------------------------------------------------------
     def _fid_norm(self, fidelity):
@@ -235,6 +244,7 @@ class ASHABO(ASHA):
             # optimizes predicted FULL-budget value; the rung machinery then
             # assigns the actual bottom-rung fidelity.
             fixed_tail_cols=1,
+            mesh=self._mesh,
         )
         self._gp_state = state
         return rows

@@ -16,6 +16,7 @@ from orion_tpu.algo.base import algo_registry
 from orion_tpu.algo.hyperband import Hyperband
 from orion_tpu.algo.sampling import clamp_objectives
 from orion_tpu.algo.tpe import _tpe_suggest, good_bad_split  # shared TPE core
+from orion_tpu.parallel import device_mesh
 
 import jax.numpy as jnp
 
@@ -39,6 +40,8 @@ class BOHB(Hyperband):
         gamma=0.25,
         n_candidates=1024,
         min_points=None,
+        n_devices=None,
+        use_mesh=False,
     ):
         super().__init__(
             space, seed=seed, num_rungs=num_rungs, reduction_factor=reduction_factor
@@ -50,13 +53,19 @@ class BOHB(Hyperband):
         self._params.update(
             gamma=self.gamma, n_candidates=self.n_candidates, min_points=self.min_points
         )
+        # Candidate-axis SPMD for the KDE-ratio matmuls (same mesh semantics
+        # as tpu_bo/asha_bo; BASELINE config #5's q=4096 scaling story).
+        self.use_mesh = use_mesh
+        self._mesh = device_mesh(n_devices) if use_mesh else None
         # budget tier -> (x (n, d) unit-cube rows, y (n,)) observation arrays.
         self._tier_x = {}
         self._tier_y = {}
 
     # Naive-copy sharing (base __deepcopy__): the per-tier observation
     # arrays are append-only; the dicts holding them are shallow-copied so
-    # the clone's key inserts don't leak back.
+    # the clone's key inserts don't leak back.  The mesh handle is not
+    # copyable.
+    _share_by_ref = ("space", "_mesh")
     _share_dicts = ("_tier_x", "_tier_y")
 
     # --- observation --------------------------------------------------------
@@ -105,6 +114,7 @@ class BOHB(Hyperband):
                 jnp.asarray(bad),
                 self.n_candidates,
                 int(num),
+                mesh=self._mesh,
             )
         )
 

@@ -17,19 +17,26 @@ import numpy as np
 
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
+from orion_tpu.parallel import device_mesh
 
 
 @algo_registry.register("tpe")
 class TPE(BaseAlgorithm):
-    def __init__(self, space, seed=None, n_init=20, gamma=0.25, n_candidates=1024):
+    def __init__(self, space, seed=None, n_init=20, gamma=0.25, n_candidates=1024,
+                 n_devices=None, use_mesh=False):
         super().__init__(
             space, seed=seed, n_init=n_init, gamma=gamma, n_candidates=n_candidates
         )
         self.n_init = n_init
         self.gamma = gamma
         self.n_candidates = n_candidates
+        self.use_mesh = use_mesh
+        self._mesh = device_mesh(n_devices) if use_mesh else None
         self._x = np.zeros((0, space.n_cols), dtype=np.float32)
         self._y = np.zeros((0,), dtype=np.float32)
+
+    # Naive-copy sharing (base __deepcopy__): the mesh handle is not copyable.
+    _share_by_ref = ("space", "_mesh", "_x", "_y")
 
     def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
         objectives = clamp_objectives(objectives, self._y)
@@ -49,6 +56,7 @@ class TPE(BaseAlgorithm):
             jnp.asarray(bad),
             self.n_candidates,
             num,
+            mesh=self._mesh,
         )
 
     def state_dict(self):
@@ -129,11 +137,16 @@ def _log_kde_product(x, points, bandwidth, log_w=None):
     return total
 
 
-@partial(jax.jit, static_argnums=(3, 4))
-def _tpe_suggest(key, good, bad, n_candidates, num):
+@partial(jax.jit, static_argnames=("n_candidates", "num", "mesh"))
+def _tpe_suggest(key, good, bad, n_candidates, num, mesh=None):
     # top_k needs k <= pool size: q-batch requests can exceed the configured
     # candidate pool (q=4096 presets), so grow the pool to fit.
     n_candidates = max(n_candidates, num)
+    if mesh is not None:
+        # The candidate axis shards over the mesh; round the pool up so the
+        # shards stay equal (XLA SPMD requires divisibility).
+        n_shards = mesh.devices.size
+        n_candidates = -(-n_candidates // n_shards) * n_shards
     k_pick, k_noise, k_mix = jax.random.split(key, 3)
     m, d = n_candidates, good.shape[1]
     bw_good = _bandwidth_1d(good)
@@ -150,6 +163,13 @@ def _tpe_suggest(key, good, bad, n_candidates, num):
     uniform = jax.random.uniform(k_mix, (m, d))
     take_uniform = (jnp.arange(m) % 4) == 3
     cands = jnp.where(take_uniform[:, None], uniform, cands)
+    if mesh is not None:
+        # Candidate-parallel SPMD, same layout as tpu_bo's fused step: the
+        # (m, n) pairwise-kernel matmuls partition along m, the KDE points
+        # replicate, and XLA inserts the top-k all-gather (orion_tpu.parallel).
+        from orion_tpu.parallel import candidate_sharding
+
+        cands = jax.lax.with_sharding_constraint(cands, candidate_sharding(mesh))
 
     score = _log_kde_product(cands, good, bw_good, log_w=log_w) - _log_kde_product(
         cands, bad, _bandwidth_1d(bad)

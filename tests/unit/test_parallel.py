@@ -59,6 +59,46 @@ def test_graft_entry_single_chip_jit():
     assert out.shape == (8, 4)
 
 
+@_needs_cpu_mesh
+@pytest.mark.parametrize(
+    "name, config",
+    [
+        (
+            "asha_bo",
+            {"n_init": 8, "n_candidates": 256, "fit_steps": 5,
+             "trust_region": True},
+        ),
+        ("bohb", {"n_candidates": 256, "min_points": 8}),
+    ],
+)
+def test_multi_fidelity_sharded_matches_unsharded(name, config):
+    """VERDICT r3 #1: the multi-fidelity engines produce the SAME suggestions
+    with and without the mesh — the sharding constraint is a layout hint, not
+    a semantic change (XLA inserts collectives; the program is identical)."""
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    def run(mesh_cfg):
+        space = build_space(
+            {**{f"x{i}": "uniform(0, 1)" for i in range(4)},
+             "budget": "fidelity(1, 16, 4)"}
+        )
+        algo = create_algo(space, {name: {**config, **mesh_cfg}}, seed=0)
+        params = space.sample(0, n=16)
+        for p in params:
+            p["budget"] = 1
+        rng = np.random.default_rng(0)
+        algo.observe(
+            params, [{"objective": float(v)} for v in rng.normal(size=len(params))]
+        )
+        out = algo.suggest(8)
+        return [[round(float(p[k]), 6) for k in sorted(p)] for p in out]
+
+    sharded = run({"use_mesh": True, "n_devices": 8})
+    unsharded = run({})
+    assert sharded == unsharded
+
+
 _TWO_PROC_SCRIPT = """
 import os, sys
 pid = int(sys.argv[1]); port = sys.argv[2]
