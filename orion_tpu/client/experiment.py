@@ -32,17 +32,19 @@ class ExperimentClient:
         return self.experiment.space
 
     def suggest(self, num=1):
-        """Reserve ``num`` trials, producing fresh ones as needed."""
+        """Reserve ``num`` trials, producing fresh ones as needed.  Batched:
+        a q-batch reservation is one pipelined storage round trip on the
+        network backend instead of q serialized ones."""
         out = []
         self.producer.update()
         while len(out) < num:
-            trial = self.experiment.reserve_trial()
-            if trial is None:
+            got = self.experiment.reserve_trials(num - len(out))
+            if not got:
                 self.producer.produce(num - len(out))
-                trial = self.experiment.reserve_trial()
-            if trial is None:
+                got = self.experiment.reserve_trials(num - len(out))
+            if not got:
                 raise WaitingForTrials("could not reserve after producing")
-            out.append(trial)
+            out.extend(got)
         return out
 
     def observe(self, trial, objective, **aux_results):
@@ -50,6 +52,19 @@ class ExperimentClient:
         for name, value in aux_results.items():
             results.append(Result(name, "statistic", value))
         self.experiment.update_completed_trial(trial, results)
+
+    def observe_all(self, trials, objectives):
+        """Batch completion: one pipelined storage round trip on the network
+        backend.  Raises the first per-trial failure after applying the whole
+        batch (matching ``observe``'s FailedUpdate contract)."""
+        pairs = [
+            (trial, [Result("objective", "objective", float(objective))])
+            for trial, objective in zip(trials, objectives)
+        ]
+        outcomes = self.experiment.update_completed_trials(pairs)
+        for outcome in outcomes:
+            if isinstance(outcome, Exception):
+                raise outcome
 
     @property
     def is_done(self):
@@ -98,10 +113,8 @@ def optimize(
             arrays = space.params_to_arrays([t.params for t in trials])
             cube = space.encode_flat(arrays)
             values = np.asarray(batch_eval(jnp.asarray(cube)))
-            for trial, value in zip(trials, values):
-                client.observe(trial, float(value))
+            client.observe_all(trials, [float(v) for v in values])
         else:
-            for trial in trials:
-                client.observe(trial, float(fn(trial.params)))
+            client.observe_all(trials, [float(fn(t.params)) for t in trials])
         n_done += len(trials)
     return client.stats()

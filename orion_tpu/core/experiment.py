@@ -126,12 +126,36 @@ class Experiment:
             trial.working_dir = self.working_dir
         return trial
 
+    def reserve_trials(self, num):
+        """Batch reservation: up to ``num`` trials in one storage round trip
+        (pipelined on the network backend).  Same lost-trial sweep guarantee
+        as :meth:`reserve_trial`."""
+        swept = self.fix_lost_trials_throttled()
+        trials = self._storage.reserve_trials(self._id, num)
+        if not trials and not swept:
+            self.fix_lost_trials()
+            trials = self._storage.reserve_trials(self._id, num)
+        for trial in trials:
+            trial.working_dir = self.working_dir
+        return trials
+
     def register_trial(self, trial, parents=()):
         trial.experiment = self._id
         trial.parents = list(parents)
         trial.submit_time = time.time()
         self._storage.register_trial(trial)
         return trial
+
+    def register_trials(self, trials, parents=()):
+        """Batch registration; returns per-trial outcomes (the trial, or its
+        DuplicateKeyError) — one pipelined round trip on the network
+        backend."""
+        now = time.time()
+        for trial in trials:
+            trial.experiment = self._id
+            trial.parents = list(parents)
+            trial.submit_time = now
+        return self._storage.register_trials(trials)
 
     def register_lie(self, trial):
         trial.experiment = self._id
@@ -140,6 +164,9 @@ class Experiment:
 
     def update_completed_trial(self, trial, results):
         return self._storage.update_completed_trial(trial, results)
+
+    def update_completed_trials(self, pairs):
+        return self._storage.update_completed_trials(pairs)
 
     def set_trial_status(self, trial, status, was=None):
         return self._storage.set_trial_status(trial, status, was=was)

@@ -162,20 +162,40 @@ class Producer:
                 log.debug("algorithm opted out of suggesting; backing off")
                 self.backoff()
                 continue
-            for params in suggested[: pool_size - registered]:
-                trial = Trial(params=params)
-                try:
-                    self.experiment.register_trial(trial, parents=self._leaf_ids)
-                    self.algorithm.register_suggestion(params)
-                    registered += 1
-                    registered_trials.append(trial)
-                except DuplicateKeyError:
+            batch = [
+                Trial(params=params)
+                for params in suggested[: pool_size - registered]
+            ]
+            # Batch registration: ONE pipelined round trip on the network
+            # backend (q=4096 would otherwise pay q serialized RTTs); per-
+            # trial DuplicateKeyError comes back as that slot's outcome.
+            outcomes = self.experiment.register_trials(
+                batch, parents=self._leaf_ids
+            )
+            had_duplicate = False
+            batch_error = None
+            for trial, outcome in zip(batch, outcomes):
+                if isinstance(outcome, DuplicateKeyError):
                     # The point IS durably registered (by us earlier or by a
                     # concurrent worker) — the algorithm must still learn it
                     # is consumed, or it will re-suggest it forever.
-                    self.algorithm.register_suggestion(params)
-                    log.debug("duplicate suggestion %s; backing off", trial.id)
-                    self.backoff()
+                    self.algorithm.register_suggestion(trial.params)
+                    log.debug("duplicate suggestion %s", trial.id)
+                    had_duplicate = True
+                elif isinstance(outcome, Exception):
+                    # Remember but keep walking the outcomes: later slots of
+                    # the same pipelined round trip WERE durably registered,
+                    # and skipping their register_suggestion would make the
+                    # algorithm re-suggest them all next round.
+                    batch_error = batch_error or outcome
+                else:
+                    self.algorithm.register_suggestion(trial.params)
+                    registered += 1
+                    registered_trials.append(trial)
+            if batch_error is not None:
+                raise batch_error
+            if had_duplicate:
+                self.backoff()
         self._flush_timings()
         self._dispatch_speculative(pool_size, registered_trials)
         return registered
@@ -201,6 +221,13 @@ class Producer:
             return
         try:
             if registered_trials:
+                # The dispatch copy predates this round's registrations (it
+                # was deepcopied in update()): mark the just-registered
+                # points consumed on IT too, or cursor-based algorithms
+                # (grid) would speculatively re-suggest the exact batch just
+                # written and pay a round of DuplicateKeyError + backoff.
+                for trial in registered_trials:
+                    algo.register_suggestion(trial.params)
                 lies = []
                 for trial in registered_trials:
                     lie = self.strategy.lie(trial)

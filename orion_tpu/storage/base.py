@@ -153,19 +153,144 @@ class DocumentStorage(BaseStorage):
         docs = self._db.read("lying_trials", {"experiment": _exp_id(experiment)})
         return [Trial.from_dict(d) for d in docs]
 
+    def _reservation_ops(self, experiment):
+        """The one reservation query/update pair — single-claim and batch
+        paths MUST write identical documents, so both build from here."""
+        now = time.time()
+        query = {
+            "experiment": _exp_id(experiment),
+            "status": {"$in": list(RESERVABLE_STATUSES)},
+        }
+        update = {"status": "reserved", "start_time": now, "heartbeat": now}
+        return query, update
+
     def reserve_trial(self, experiment):
         """Atomically claim one pending trial (the cross-worker sync point;
         reference `legacy.py:253-273`)."""
-        now = time.time()
-        doc = self._db.read_and_write(
-            "trials",
-            {
-                "experiment": _exp_id(experiment),
-                "status": {"$in": list(RESERVABLE_STATUSES)},
-            },
-            {"status": "reserved", "start_time": now, "heartbeat": now},
-        )
+        query, update = self._reservation_ops(experiment)
+        doc = self._db.read_and_write("trials", query, update)
         return Trial.from_dict(doc) if doc else None
+
+    def reserve_trials(self, experiment, num):
+        """Claim up to ``num`` pending trials; each claim is individually
+        atomic (repeated find-one-and-updates — every op sees the previous
+        op's status flip, so the claims are distinct).  On a backend exposing
+        ``pipeline`` (the network driver) the whole batch rides one round
+        trip; q=4096 reservation over TCP would otherwise pay 4096 serialized
+        RTTs."""
+        if num <= 0:
+            return []
+        query, update = self._reservation_ops(experiment)
+        pipeline = getattr(self._db, "pipeline", None)
+        if pipeline is None:
+            out = []
+            for _ in range(num):
+                trial = self.reserve_trial(experiment)
+                if trial is None:
+                    break
+                out.append(trial)
+            return out
+        # Probe with ONE claim first: callers reserve-then-produce, so the
+        # common steady state is an EMPTY queue — pipelining num futile
+        # find-one-and-updates there would double the server's reservation
+        # work every round.  Non-empty pays one extra round trip.
+        first = self._db.read_and_write("trials", query, update)
+        if first is None:
+            return []
+        if num == 1:
+            return [Trial.from_dict(first)]
+        docs = [first] + pipeline(
+            [("read_and_write", ["trials", query, update], {})] * (num - 1)
+        )
+        out, error = [], None
+        for doc in docs:
+            if isinstance(doc, Exception):
+                error = error or doc
+            elif doc is not None:
+                out.append(Trial.from_dict(doc))
+        if error is not None and not out:
+            # Nothing claimed + server-side failure: surface it exactly as
+            # the per-op path would — treating it as "no trials pending"
+            # masks the fault and sends the caller off to produce duplicates.
+            raise error
+        # With claims in hand, RETURN them even if a later slot errored:
+        # raising would strand already-reserved trials (no owner, no
+        # heartbeat) until the lost-trial sweep.  A persistent fault will
+        # surface on the next (empty-handed) round.
+        return out
+
+    def register_trials(self, trials):
+        """Batch-register; returns one outcome per trial: the trial itself on
+        success or the per-trial exception (DuplicateKeyError for an
+        already-taken point — slot independence matters: one duplicate must
+        not block the rest of a q-batch).  One pipelined round trip on the
+        network driver."""
+        now = time.time()
+        for trial in trials:
+            trial.submit_time = trial.submit_time or now
+        pipeline = getattr(self._db, "pipeline", None)
+        if pipeline is None:
+            out = []
+            for trial in trials:
+                try:
+                    self._db.write("trials", trial.to_dict())
+                    out.append(trial)
+                except Exception as exc:
+                    out.append(exc)
+            return out
+        results = pipeline(
+            [("write", ["trials", trial.to_dict()], {}) for trial in trials]
+        )
+        return [
+            result if isinstance(result, Exception) else trial
+            for trial, result in zip(trials, results)
+        ]
+
+    def update_completed_trials(self, pairs):
+        """Batch-complete ``[(trial, results), ...]`` — one pipelined round
+        trip on the network driver; per-trial FailedUpdate surfaces in the
+        returned outcome list instead of aborting the batch."""
+        outcomes = []
+        pipeline = getattr(self._db, "pipeline", None)
+        if pipeline is None:
+            for trial, results in pairs:
+                try:
+                    outcomes.append(self.update_completed_trial(trial, results))
+                except FailedUpdate as exc:
+                    outcomes.append(exc)
+            return outcomes
+        now = time.time()
+        ops = []
+        for trial, results in pairs:
+            trial.results = list(results)
+            trial.end_time = now
+            ops.append(
+                (
+                    "read_and_write",
+                    [
+                        "trials",
+                        {"_id": trial.id},
+                        {
+                            "results": [r.to_dict() for r in trial.results],
+                            "end_time": trial.end_time,
+                            "status": "completed",
+                        },
+                    ],
+                    {},
+                )
+            )
+        docs = pipeline(ops)
+        for (trial, _results), doc in zip(pairs, docs):
+            if isinstance(doc, Exception):
+                outcomes.append(doc)
+            elif doc is None:
+                outcomes.append(
+                    FailedUpdate(f"completed trial {trial.id} vanished from storage")
+                )
+            else:
+                trial.status = "completed"
+                outcomes.append(trial)
+        return outcomes
 
     def fetch_trials(self, experiment=None, uid=None):
         query = {"experiment": uid if uid is not None else _exp_id(experiment)}

@@ -309,6 +309,24 @@ def serve(host="127.0.0.1", port=8765, persist=None, secret=None):  # pragma: no
         server.shutdown()
 
 
+def _translate(response, raise_errors=True):
+    """Wire response -> result, or the mapped exception (raised, or returned
+    as an instance when ``raise_errors=False`` for pipelined batches)."""
+    if response.get("ok"):
+        return response.get("result")
+    error = response.get("error")
+    message = response.get("message", "")
+    exc_cls = {
+        "DuplicateKeyError": DuplicateKeyError,
+        "KeyError": KeyError,
+        "AuthenticationError": AuthenticationError,
+    }.get(error)
+    exc = exc_cls(message) if exc_cls else DatabaseError(f"{error}: {message}")
+    if raise_errors:
+        raise exc
+    return exc
+
+
 class NetworkDB:
     """AbstractDB-contract client for a :class:`DBServer`.
 
@@ -454,17 +472,87 @@ class NetworkDB:
                             f"connection to {self.host}:{self.port} lost during "
                             f"{op!r}: {exc}"
                         ) from exc
-        if response.get("ok"):
-            return response.get("result")
-        error = response.get("error")
-        message = response.get("message", "")
-        if error == "DuplicateKeyError":
-            raise DuplicateKeyError(message)
-        if error == "KeyError":
-            raise KeyError(message)
-        if error == "AuthenticationError":
-            raise AuthenticationError(message)
-        raise DatabaseError(f"{error}: {message}")
+        return _translate(response)
+
+    def pipeline(self, ops):
+        """Execute ``[(op, args, kwargs), ...]`` over ONE round trip.
+
+        All requests are written in a single send; the server's handler loop
+        consumes them back-to-back off the stream (each op individually
+        atomic, exactly as if sent one by one), and the responses are read in
+        order afterwards.  This is what makes q-batch reservation affordable
+        over the wire: q pipelined find-one-and-updates cost ~1 RTT instead
+        of q serialized ones (the role MongoDB's wire batching plays for the
+        reference, `mongodb.py:229-247`).
+
+        Returns a list the same length as ``ops``: each element is the op's
+        result, or an *exception instance* (DuplicateKeyError/KeyError/...)
+        for that op — per-op failures must not abort the batch (a duplicate
+        in slot 3 says nothing about slot 4).  A connection drop mid-batch
+        raises DatabaseError: mutations may or may not have applied, same
+        contract as a lost in-flight ``_call``.
+        """
+        if not ops:
+            return []
+        payload = b"".join(
+            _dumps({"op": op, "args": list(args), "kwargs": kwargs})
+            for op, args, kwargs in ops
+        )
+        with self._lock:
+            # Mirror _call's connect contract: nothing has been sent yet, so
+            # one reconnect retry is safe, and a dead server surfaces as
+            # DatabaseError (the type the CLI handles), never a raw OSError.
+            try:
+                self._probe_idle_connection()
+                if self._sock is None:
+                    self._connect()
+            except (OSError, ConnectionError):
+                self._close()
+                try:
+                    self._connect()
+                except (OSError, ConnectionError) as exc:
+                    raise DatabaseError(
+                        f"cannot connect to {self.host}:{self.port} for "
+                        f"pipeline of {len(ops)} ops: {exc}"
+                    ) from exc
+            # Responses are drained CONCURRENTLY with the send (reads and
+            # writes ride opposite socket directions): a send-then-read
+            # pipeline deadlocks once a big batch fills both kernel socket
+            # buffers — the server blocks writing responses nobody reads,
+            # stops consuming requests, and the client's sendall blocks too.
+            responses, reader_error = [], []
+
+            def _drain():
+                try:
+                    for _ in ops:
+                        response = _read_line(self._file)
+                        if response is None:
+                            raise ConnectionError("server closed the connection")
+                        responses.append(response)
+                except Exception as exc:  # surfaced after join
+                    reader_error.append(exc)
+
+            reader = threading.Thread(target=_drain, daemon=True)
+            reader.start()
+            try:
+                self._sock.sendall(payload)
+            except OSError as exc:
+                reader_error.append(exc)
+            # No join deadline: the socket timeout already bounds each READ
+            # (60s of silence = dead server, surfaced by the reader), so the
+            # reader always terminates — while a big batch whose responses
+            # are steadily streaming in may legitimately take longer than
+            # any single-op timeout and must not be declared lost mid-flight.
+            reader.join()
+            if reader_error:
+                exc = reader_error[0]
+                self._close()
+                raise DatabaseError(
+                    f"connection to {self.host}:{self.port} lost during "
+                    f"pipeline of {len(ops)} ops: {exc}"
+                ) from exc
+            self._last_used = time.monotonic()
+        return [_translate(r, raise_errors=False) for r in responses]
 
     # --- AbstractDB contract --------------------------------------------------
     def ping(self):

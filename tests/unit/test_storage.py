@@ -741,3 +741,80 @@ def test_value_map_buckets_do_not_grow_with_history():
     db.remove("c", {})
     col = db._col("c")
     assert col._value_maps["status"] == {}
+
+
+# --- batch (pipelined) protocol ops ----------------------------------------
+
+
+def test_reserve_trials_batch_claims_distinct(storage):
+    """reserve_trials(n) claims n DISTINCT trials (each claim individually
+    atomic) on every backend — one pipelined round trip on the network
+    driver, a loop elsewhere."""
+    for i in range(6):
+        storage.register_trial(new_trial(i))
+    got = storage.reserve_trials("exp-id", 4)
+    assert len(got) == 4
+    assert len({t.id for t in got}) == 4
+    assert all(t.status == "reserved" for t in got)
+    # Over-asking returns what exists, no error.
+    rest = storage.reserve_trials("exp-id", 10)
+    assert len(rest) == 2
+    assert storage.reserve_trials("exp-id", 3) == []
+
+
+def test_register_trials_batch_reports_per_trial_duplicates(storage):
+    """A duplicate in one slot must not block the rest of the batch: the
+    outcome list carries the trial on success and the DuplicateKeyError for
+    the taken slot."""
+    storage.register_trial(new_trial(1))
+    batch = [new_trial(0), new_trial(1), new_trial(2)]
+    outcomes = storage.register_trials(batch)
+    assert outcomes[0] is batch[0]
+    assert isinstance(outcomes[1], DuplicateKeyError)
+    assert outcomes[2] is batch[2]
+    assert len(storage.fetch_trials(uid="exp-id")) == 3
+
+
+def test_update_completed_trials_batch(storage):
+    from orion_tpu.core.trial import Result
+
+    for i in range(3):
+        storage.register_trial(new_trial(i))
+    got = storage.reserve_trials("exp-id", 3)
+    pairs = [
+        (t, [Result("objective", "objective", float(i))])
+        for i, t in enumerate(got)
+    ]
+    outcomes = storage.update_completed_trials(pairs)
+    assert all(not isinstance(o, Exception) for o in outcomes)
+    done = storage.fetch_trials_by_status("exp-id", "completed")
+    assert sorted(t.objective.value for t in done) == [0.0, 1.0, 2.0]
+
+
+def test_network_pipeline_one_round_trip_semantics():
+    """The raw pipeline op: N requests in one send, N ordered replies, per-op
+    errors as instances (a DuplicateKeyError in slot 1 leaves slot 2 applied)."""
+    from orion_tpu.storage import DBServer, NetworkDB
+    from orion_tpu.utils.exceptions import DuplicateKeyError as Dup
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    try:
+        db = NetworkDB(host=host, port=port)
+        db.ensure_index("c", ["k"], unique=True)
+        results = db.pipeline(
+            [
+                ("write", ["c", {"k": 1}], {}),
+                ("write", ["c", {"k": 1}], {}),  # duplicate
+                ("write", ["c", {"k": 2}], {}),
+                ("count", ["c"], {}),
+            ]
+        )
+        assert not isinstance(results[0], Exception)
+        assert isinstance(results[1], Dup)
+        assert not isinstance(results[2], Exception)
+        assert results[3] == 2
+        assert db.pipeline([]) == []
+    finally:
+        server.shutdown()
+        server.server_close()
