@@ -112,8 +112,8 @@ PRESETS = {
     # budget as thompson-rosenbrock20/cmaes-rosenbrock20.  Small batches on
     # purpose: the trust region adapts once per observe round, and 60 rounds
     # of success/failure signal are what walk the box down the valley
-    # (measured over 6 seeds: median regret ~173, best ~95 — vs 46 for
-    # cmaes and ~1.3e4 for the global-candidate GP preset).
+    # (5 chip seeds: median regret 258 [82-866] — behind cmaes' 46 on this
+    # pure valley, ahead of default tpu_bo's 673; see BENCH_SEEDS.json).
     "turbo-rosenbrock20": dict(
         priors=_uniform_priors(20), fn="rosenbrock20",
         algorithm={"turbo": {"n_init": 64, "n_candidates": 8192,
@@ -128,9 +128,10 @@ PRESETS = {
         priors=_uniform_priors(20), fn="rosenbrock20",
         # Canonical generational cadence (batch == popsize): generations are
         # the scarce axis for ES, and each update wants samples drawn from
-        # the freshly-updated distribution.  Measured at 1024 trials this
-        # reaches regret ~46 vs ~1.3e4 for the GP-Thompson preset — valley
-        # landscapes reward covariance adaptation.
+        # the freshly-updated distribution.  5 chip seeds at 1024 trials:
+        # median regret 46 [42-408] vs 673 for the (round-4 robust-default)
+        # GP preset and 258 for turbo — valley landscapes reward covariance
+        # adaptation.
         algorithm={"cmaes": {"popsize": 16}},
         max_trials=1024, batch_size=16,
     ),
@@ -146,8 +147,46 @@ PRESETS = {
 }
 
 
-def run_preset(name, seed=0, **overrides):
+def run_preset(name, seed=0, algo_overrides=None, **overrides):
+    """``algo_overrides`` merge into the algorithm's OWN config dict (e.g.
+    ``{"use_mesh": True}`` to shard an ackley50 preset's suggest step over
+    the visible devices — BASELINE config #5's v5e-8 shape); ``overrides``
+    replace top-level preset keys (max_trials, batch_size, ...)."""
     cfg = {**PRESETS[name], **overrides}
+    if algo_overrides:
+        import inspect
+
+        from orion_tpu.algo.base import _import_builtins, algo_registry
+
+        _import_builtins()
+        algorithm = cfg["algorithm"]
+        if not isinstance(algorithm, dict):
+            algorithm = {algorithm: {}}
+        merged = {}
+        for algo, params in algorithm.items():
+            accepted = inspect.signature(algo_registry.get(algo).__init__).parameters
+            # A **kwargs constructor (turbo forwards everything to tpu_bo)
+            # accepts any override.
+            has_var_kw = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD for p in accepted.values()
+            )
+            extra = {
+                k: v for k, v in algo_overrides.items()
+                if has_var_kw or k in accepted
+            }
+            skipped = set(algo_overrides) - set(extra)
+            if skipped:
+                import sys
+
+                # Loud, not fatal: `--use-mesh` over the full preset list must
+                # not crash on the algorithms that have no mesh to use.  On
+                # stderr — stdout is a machine-readable JSONL stream.
+                print(
+                    f"# {name}: {algo} does not accept {sorted(skipped)}; skipped",
+                    file=sys.stderr,
+                )
+            merged[algo] = {**params, **extra}
+        cfg["algorithm"] = merged
     if "fn_params" in cfg:
         # Host-side params-dict objective (mixed spaces with categoricals).
         fn, batch_eval = cfg.pop("fn_params"), None
@@ -181,28 +220,79 @@ def run_preset(name, seed=0, **overrides):
     }
 
 
+def run_preset_seeds(name, n_seeds, algo_overrides=None, **overrides):
+    """Run a preset over seeds 0..n_seeds-1 and aggregate.
+
+    Single-seed numbers on these landscapes sit on >2x seed variance
+    (BASELINE.md's own admissions) — any headline claim must be a
+    median +/- range, so the aggregate carries per-seed regrets verbatim
+    alongside median/min/max.
+    """
+    import statistics
+
+    per_seed = [
+        run_preset(name, seed=s, algo_overrides=algo_overrides, **overrides)
+        for s in range(n_seeds)
+    ]
+    regrets = [r["simple_regret"] for r in per_seed if r["simple_regret"] is not None]
+    rates = [r["suggestions_per_sec"] for r in per_seed]
+    out = {
+        "preset": name,
+        "seeds": n_seeds,
+        "regret_median": round(statistics.median(regrets), 6) if regrets else None,
+        "regret_min": round(min(regrets), 6) if regrets else None,
+        "regret_max": round(max(regrets), 6) if regrets else None,
+        "regret_per_seed": [round(r, 6) for r in regrets],
+        "suggestions_per_sec_median": round(statistics.median(rates), 2),
+        "wall_s_total": round(sum(r["wall_s"] for r in per_seed), 2),
+    }
+    return out
+
+
 def main(argv=None):
     import sys
 
     argv = list(argv if argv is not None else sys.argv[1:])
-    if "--op" in argv:
-        import argparse
+    import argparse
 
-        parser = argparse.ArgumentParser(prog="orion_tpu.benchmarks.runner")
-        parser.add_argument("--op", choices=["gram"], required=True)
-        parser.add_argument("--kind", default="matern52",
-                            choices=["matern52", "rbf"])
-        parser.add_argument("--reps", type=int, default=8)
-        # parse_args errors out loudly on leftover preset names — a user
-        # combining both must not believe the presets silently ran.
-        args = parser.parse_args(argv)
+    parser = argparse.ArgumentParser(prog="orion_tpu.benchmarks.runner")
+    parser.add_argument("--op", choices=["gram"],
+                        help="run an op micro-benchmark instead of presets")
+    parser.add_argument("--kind", default="matern52",
+                        choices=["matern52", "rbf"])
+    parser.add_argument("--reps", type=int, default=8)
+    parser.add_argument("--seeds", type=int, default=None, metavar="N",
+                        help="run each preset over seeds 0..N-1 and print "
+                             "the median [min-max] aggregate (N >= 1)")
+    parser.add_argument("--use-mesh", action="store_true",
+                        help="shard each preset's suggest step over the "
+                             "visible devices (mesh-capable algorithms only)")
+    parser.add_argument("presets", nargs="*", metavar="preset",
+                        choices=[[]] + list(PRESETS),
+                        help=f"presets to run (default: all). {list(PRESETS)}")
+    args = parser.parse_args(argv)
+    if args.op:
+        # Explicit guard (parse_args accepts both): a user combining --op
+        # with preset names must not believe the presets silently ran.
+        if args.presets:
+            parser.error("--op and preset names are mutually exclusive")
         from orion_tpu.benchmarks.gram_bench import run_gram_bench
 
         run_gram_bench(kind=args.kind, reps=args.reps)
         return
-    names = argv or list(PRESETS)
-    for name in names:
-        print(json.dumps(run_preset(name)))
+    if args.kind != "matern52" or args.reps != 8:
+        # --kind/--reps configure the --op micro-bench only; dropping them
+        # silently would let the user believe they shaped the preset runs.
+        parser.error("--kind/--reps require --op")
+    if args.seeds is not None and args.seeds < 1:
+        parser.error("--seeds must be >= 1")
+    algo_overrides = {"use_mesh": True} if args.use_mesh else None
+    for name in args.presets or list(PRESETS):
+        if args.seeds is not None:
+            print(json.dumps(run_preset_seeds(
+                name, args.seeds, algo_overrides=algo_overrides)))
+        else:
+            print(json.dumps(run_preset(name, algo_overrides=algo_overrides)))
 
 
 if __name__ == "__main__":

@@ -416,3 +416,19 @@ def test_tpe_family_q_batch_larger_than_candidate_pool():
     algo.observe(params, [{"objective": quadratic(p)} for p in params])
     big = algo.suggest(64)  # > n_candidates
     assert len(big) == 64
+
+
+def test_turbo_polish_splice_clamped_to_tiny_pool():
+    """ADVICE r3: a config with n_candidates far below the polish count
+    (q=512 -> formula 32) must not have the splice eat the whole pool —
+    the candidate count, and with it the mesh-divisibility invariant and
+    select_q's k <= pool assumption, must survive."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(
+        space, {"turbo": {"n_init": 4, "n_candidates": 32, "fit_steps": 3}},
+        seed=0,
+    )
+    params = algo.suggest(8)
+    algo.observe(params, [{"objective": quadratic(p)} for p in params])
+    out = algo.suggest(512)
+    assert len(out) == 512
