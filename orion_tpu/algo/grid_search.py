@@ -17,6 +17,13 @@ from orion_tpu.space.dims import Categorical, Integer
 class GridSearch(BaseAlgorithm):
     """``n_values`` points per dimension (categoricals: one per category)."""
 
+    # The sweep order never depends on observations, so a speculatively
+    # dispatched batch is identical to a synchronous one (the producer
+    # overlaps the next round's suggest with trial execution — BASELINE's
+    # speculative-dispatch A/B).
+    supports_async_suggest = True
+    speculation_safe = True
+
     MAX_GRID = 1_000_000
 
     def __init__(self, space, n_values=10, seed=None):
