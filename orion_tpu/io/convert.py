@@ -84,8 +84,12 @@ class GenericConverter:
     # The marker alternatives need boundaries: a bare "-" must not eat the
     # front of "-5" (old bare-token capture), and ">name" must span
     # hyphenated names or "m~>new-name" would template a dangling "-name".
+    # The call-form parentheses allow ONE level of nesting
+    # (``choices([(1, 2), (3, 4)])``) instead of stopping at the first ``)``;
+    # a fully greedy ``\(.*\)`` (the reference's rule, `convert.py:158`)
+    # would instead swallow a second ``name~prior(...)`` on the same line.
     PRIOR_RE = re.compile(
-        r"([\w\.\-/]+)~([+]?[\w.]+\([^)]*\)|-(?![\w.\-])|>[\w.\-]+|[^\s'\"]+)"
+        r"([\w\.\-/]+)~([+]?[\w.]+\((?:[^()]|\([^()]*\))*\)|-(?![\w.\-])|>[\w.\-]+|[^\s'\"]+)"
     )
 
     def __init__(self):

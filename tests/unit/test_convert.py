@@ -100,6 +100,24 @@ def test_generic_markers_and_quoted_choices(tmp_path):
     }
 
 
+def test_generic_nested_paren_priors_captured_whole(tmp_path):
+    """ADVICE r3: ``choices([(1, 2), (3, 4)])`` must capture through the LAST
+    parenthesis (one nesting level), not truncate at the first ``)`` — while
+    two priors on one line still split correctly (a fully greedy ``\\(.*\\)``
+    would swallow the second one)."""
+    src = tmp_path / "n.cfg"
+    src.write_text(
+        "pair: p~choices([(1, 2), (3, 4)])\n"
+        "two: a~uniform(0, 1) b~uniform(2, 3)\n"
+    )
+    flat = GenericConverter().parse(str(src))
+    assert flat == {
+        "/p": "~choices([(1, 2), (3, 4)])",
+        "/a": "~uniform(0, 1)",
+        "/b": "~uniform(2, 3)",
+    }
+
+
 def test_generic_survives_adversarial_text(tmp_path):
     """Arbitrary junk (binary-ish bytes, regex metacharacters, lone tildes)
     must parse without crashing and round-trip unchanged when no priors
