@@ -118,15 +118,47 @@ class BranchingPrompt(cmd.Cmd):
         return True
 
     # --- completion -----------------------------------------------------------
-    def _dim_names(self):
+    # Per-command candidates (reference branching_prompt.py:77-485 ships
+    # complete_* methods per command): each command completes only the names
+    # it can actually act on, so tab after `remove ` never offers a NEW
+    # dimension it would reject.
+
+    _CHANGE_TYPES = ("noeffect", "unsure", "break")
+
+    def _conflict_names(self, *types):
         names = []
-        for conflict in self.builder.conflicts.conflicts:
-            if hasattr(conflict, "name"):
+        for conflict in self.builder.conflicts.get(list(types) or None):
+            if hasattr(conflict, "name") and not conflict.is_resolved:
                 names.append(conflict.name)
         return names
 
+    @staticmethod
+    def _match(candidates, text):
+        return [c for c in candidates if c.startswith(text)]
+
+    def complete_add(self, text, _line, _begidx, _endidx):
+        return self._match(self._conflict_names(C.NewDimensionConflict), text)
+
+    def complete_remove(self, text, _line, _begidx, _endidx):
+        return self._match(self._conflict_names(C.MissingDimensionConflict), text)
+
+    def complete_rename(self, text, line, _begidx, _endidx):
+        # First argument: the missing (old) name; second: the new name.
+        n_args = len(line.split())
+        if n_args > 2 or (n_args == 2 and not text):
+            source = self._conflict_names(C.NewDimensionConflict)
+        else:
+            source = self._conflict_names(C.MissingDimensionConflict)
+        return self._match(source, text)
+
+    def complete_code(self, text, _line, _begidx, _endidx):
+        return self._match(self._CHANGE_TYPES, text)
+
+    complete_commandline = complete_code
+    complete_config = complete_code
+
     def completedefault(self, text, _line, _begidx, _endidx):
-        return [n for n in self._dim_names() if n.startswith(text)]
+        return self._match(self._conflict_names(), text)
 
 
 def _literal(token):

@@ -486,3 +486,31 @@ def test_branching_prompt_bad_input_keeps_session(capsys):
     out = capsys.readouterr().out
     assert "cannot resolve" in out
     assert conflicts.are_resolved
+
+
+def test_branching_prompt_per_command_completion():
+    """Tab completion offers only what each command can act on (reference
+    ships complete_* per command): `add` sees new dims, `remove`/`rename`
+    see missing dims, the change classifiers see the three change types."""
+    from orion_tpu.evc.branching_prompt import BranchingPrompt
+    from orion_tpu.evc.builder import ExperimentBranchBuilder
+
+    conflicts = detect_conflicts(
+        {**old_config(), "priors": {"/x": "uniform(0, 10)", "/old": "uniform(0, 1)"}},
+        {"priors": {"/x": "uniform(0, 10)", "/y": "uniform(0, 5)"}},
+    )
+    builder = ExperimentBranchBuilder(conflicts, manual_resolution=True)
+    prompt = BranchingPrompt(builder)
+    assert prompt.complete_add("/", "add /", 4, 5) == ["/y"]
+    assert prompt.complete_add("/z", "add /z", 4, 6) == []
+    assert prompt.complete_remove("/", "remove /", 7, 8) == ["/old"]
+    # rename completes old (missing) name first, then the new name.
+    assert prompt.complete_rename("/", "rename /", 7, 8) == ["/old"]
+    assert prompt.complete_rename("/", "rename /old /", 12, 13) == ["/y"]
+    assert prompt.complete_code("un", "code un", 5, 7) == ["unsure"]
+    assert prompt.complete_commandline("", "commandline ", 12, 12) == [
+        "noeffect", "unsure", "break"
+    ]
+    # Resolved conflicts drop out of the candidates.
+    prompt.do_add("/y 2.5")
+    assert prompt.complete_add("/", "add /", 4, 5) == []
