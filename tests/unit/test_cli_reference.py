@@ -1,0 +1,17 @@
+"""The checked-in command reference must match the live argparse tree."""
+
+import os
+
+
+def test_commands_md_is_current():
+    from orion_tpu.cli.docgen import generate_markdown
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "..", "docs", "commands.md"
+    )
+    with open(path) as handle:
+        checked_in = handle.read()
+    assert checked_in == generate_markdown(), (
+        "docs/commands.md is stale — regenerate with "
+        "`python -m orion_tpu.cli.docgen docs/commands.md`"
+    )
