@@ -310,10 +310,10 @@ def _net_worker_reserve(host, port, out_queue):
     out_queue.put(claimed)
 
 
-def test_network_concurrent_reservation_across_processes():
-    """Multiple client processes against one AUTHENTICATED server: every
-    trial claimed exactly once — the multi-node equivalent of the pickled
-    flock test, with the HMAC handshake in every process."""
+def _run_network_reservation_race(worker_fn):
+    """Shared driver: 4 client processes against one AUTHENTICATED server
+    must claim the 20 trials exactly once between them — the multi-node
+    equivalent of the pickled flock test, HMAC handshake in every process."""
     from orion_tpu.storage import DBServer
 
     server = DBServer(port=0, secret="mp-secret")
@@ -331,7 +331,7 @@ def test_network_concurrent_reservation_across_processes():
         ctx = multiprocessing.get_context("spawn")
         queue = ctx.Queue()
         procs = [
-            ctx.Process(target=_net_worker_reserve, args=(host, port, queue))
+            ctx.Process(target=worker_fn, args=(host, port, queue))
             for _ in range(4)
         ]
         for p in procs:
@@ -341,11 +341,15 @@ def test_network_concurrent_reservation_across_processes():
             p.join(timeout=60)
 
         flat = [tid for chunk in results for tid in chunk]
-        assert len(flat) == 20
+        assert len(flat) == 20, "a trial was double-claimed or lost"
         assert set(flat) == all_ids
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_network_concurrent_reservation_across_processes():
+    _run_network_reservation_race(_net_worker_reserve)
 
 
 def test_network_server_persistence_across_restarts(tmp_path):
@@ -818,3 +822,21 @@ def test_network_pipeline_one_round_trip_semantics():
     finally:
         server.shutdown()
         server.server_close()
+
+
+def _net_worker_reserve_batched(host, port, out_queue):
+    storage = create_storage(
+        {"type": "network", "host": host, "port": port, "secret": "mp-secret"}
+    )
+    claimed = []
+    while True:
+        got = storage.reserve_trials("exp-id", 4)
+        if not got:
+            break
+        claimed.extend(t.id for t in got)
+    out_queue.put(claimed)
+
+
+def test_network_concurrent_batched_reservation_across_processes():
+    """The PIPELINED batch claims race exactly like per-op ones."""
+    _run_network_reservation_race(_net_worker_reserve_batched)
