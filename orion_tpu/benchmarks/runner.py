@@ -110,17 +110,18 @@ PRESETS = {
     # Trust-region GP-BO (TuRBO-style + elite-covariance/directional
     # candidates + posterior-mean polish) on the same 20-D valley and trial
     # budget as thompson-rosenbrock20/cmaes-rosenbrock20.  Small batches on
-    # purpose: the trust region adapts once per observe round, and 60 rounds
-    # of success/failure signal are what walk the box down the valley
-    # (5 chip seeds: median regret 258 [82-866] — behind cmaes' 46 on this
-    # pure valley, ahead of default tpu_bo's 673; see BENCH_SEEDS.json).
+    # purpose: the trust region adapts ONCE PER OBSERVE ROUND, and rounds
+    # of success/failure signal are what walk the box down the valley —
+    # measured on the chip, batch 8 (128 rounds) more than halves batch
+    # 16's median (5 seeds: 47.5 [24.5-452.5] vs 258 [82-866]), pulling
+    # even with cmaes' 46; see BENCH_SEEDS.json.
     "turbo-rosenbrock20": dict(
         priors=_uniform_priors(20), fn="rosenbrock20",
         algorithm={"turbo": {"n_init": 64, "n_candidates": 8192,
                              "fit_steps": 25, "refit_steps": 6,
                              "tr_fail_tol": 2, "tr_perturb_dims": 4,
                              "tr_length_init": 0.4, "tr_length_max": 0.8}},
-        max_trials=1024, batch_size=16,
+        max_trials=1024, batch_size=8,
     ),
     # Evolution-strategy family on a hard multimodal landscape where GP
     # lengthscales saturate — same budget as thompson-rosenbrock20.

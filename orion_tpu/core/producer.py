@@ -36,6 +36,8 @@ class Producer:
         self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
         self.failure_count = 0
         self._pending_timings = []
+        self._n_completed_seen = 0
+        self._update_epoch = 0
         # Speculative next-round suggestion: (handle, algo) dispatched at the
         # end of produce() so the device round trip overlaps trial execution.
         self._speculative = None
@@ -62,11 +64,28 @@ class Producer:
 
         Trials come through the EVC tree: a branched child warm-starts from
         its ancestors' completed trials, adapted hop by hop (reference
-        `evc/experiment.py:154-226` — the point of branching)."""
+        `evc/experiment.py:154-226` — the point of branching).
+
+        The round's snapshot comes from storage.fetch_update_view, which
+        count-gates the completed history on capable backends (update()
+        runs every produce round AND every backoff; re-reading the whole
+        completed history each time costs O(trials) per call) and keeps
+        the single full fetch elsewhere — see its docstring for the
+        consistency and ordering contract."""
         if self._tree_fetcher is not None:
             trials = self._tree_fetcher.fetch()
         else:
-            trials = self.experiment.fetch_trials()
+            # Every 16th sync forces the gate open: the count gate assumes
+            # the completed count only grows, which a concurrent
+            # db-level remove of a completed trial (offset by a fresh
+            # completion) could violate — the periodic full read bounds
+            # that staleness window instead of trusting the invariant
+            # forever.
+            self._update_epoch += 1
+            known = self._n_completed_seen if self._update_epoch % 16 else -1
+            trials, self._n_completed_seen = (
+                self.experiment.storage.fetch_update_view(self.experiment, known)
+            )
         completed = [t for t in trials if t.status == "completed" and t.objective]
         incomplete = [t for t in trials if not t.is_stopped]
         self._update_algorithm(completed)

@@ -295,8 +295,56 @@ class DocumentStorage(BaseStorage):
     def fetch_trials(self, experiment=None, uid=None):
         query = {"experiment": uid if uid is not None else _exp_id(experiment)}
         docs = self._db.read("trials", query)
-        docs.sort(key=lambda d: (d.get("submit_time") or 0.0, str(d.get("_id"))))
+        docs.sort(key=_trial_doc_order)
         return [Trial.from_dict(d) for d in docs]
+
+    def fetch_update_view(self, experiment, known_completed=-1):
+        """The producer's per-round sync snapshot: ``(trials, n_completed)``.
+
+        When the backend advertises ``cheap_counts``, the completed history
+        is count-gated — re-read only when the completed count moved past
+        ``known_completed`` (completed is terminal, so the count can only
+        grow); otherwise the round reads just the (small) non-completed
+        set.  On a pipeline-capable backend the non-completed read and the
+        count share ONE round trip.  Backends without cheap ops (the
+        pickled file pays a full lock/unpickle cycle per op) keep the
+        single full fetch.
+
+        The two reads are not one atomic snapshot: a trial completing
+        between them appears in both (its completed view wins below) or
+        flips the count so the gate re-opens — it can never vanish from
+        the round.  Trials are returned in the same (submit_time, id)
+        order ``fetch_trials`` delivers, which is what keeps replay
+        deterministic.
+        """
+        if not getattr(self._db, "cheap_counts", False):
+            trials = self.fetch_trials(experiment)
+            return trials, -1
+        exp_id = _exp_id(experiment)
+        noncompleted_query = {"experiment": exp_id, "status": {"$ne": "completed"}}
+        completed_query = {"experiment": exp_id, "status": "completed"}
+        pipeline = getattr(self._db, "pipeline", None)
+        if pipeline is not None:
+            nc_docs, n_completed = pipeline(
+                [
+                    ("read", ["trials", noncompleted_query], {}),
+                    ("count", ["trials", completed_query], {}),
+                ]
+            )
+            for result in (nc_docs, n_completed):
+                if isinstance(result, Exception):
+                    raise result
+        else:
+            nc_docs = self._db.read("trials", noncompleted_query)
+            n_completed = self._db.count("trials", completed_query)
+        if n_completed != known_completed:
+            done_docs = self._db.read("trials", completed_query)
+        else:
+            done_docs = []
+        by_id = {d["_id"]: d for d in nc_docs}
+        by_id.update((d["_id"], d) for d in done_docs)  # completed view wins
+        docs = sorted(by_id.values(), key=_trial_doc_order)
+        return [Trial.from_dict(d) for d in docs], n_completed
 
     def fetch_trials_by_status(self, experiment, status):
         statuses = [status] if isinstance(status, str) else list(status)
@@ -443,6 +491,13 @@ class DocumentStorage(BaseStorage):
             {"experiment": _exp_id(experiment), "status": {"$ne": "completed"}},
         )
         return [Trial.from_dict(d) for d in docs]
+
+
+def _trial_doc_order(doc):
+    """THE trial ordering: every path that hands trials to an algorithm
+    must sort with this one key, or observe order (and with it replay
+    determinism) diverges between paths."""
+    return (doc.get("submit_time") or 0.0, str(doc.get("_id")))
 
 
 def _exp_id(experiment):

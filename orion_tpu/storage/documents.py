@@ -387,7 +387,11 @@ class Collection:
         return None
 
     def count(self, query=None):
-        return len(self.find(query, projection={"_id": 1}))
+        # No projection/copy per match — the producer's count-gated sync
+        # calls this every round; it must cost a scan, not allocations.
+        return sum(
+            1 for doc in self._candidates(query) if _matches(doc, query)
+        )
 
     def remove(self, query=None):
         doomed = [
@@ -401,6 +405,10 @@ class Collection:
 
 class MemoryDB:
     """Thread-safe in-memory database of named collections."""
+
+    #: A count/targeted query costs a scan here, not a full-DB reload —
+    #: the producer's count-gated sync keys on this (see Producer.update).
+    cheap_counts = True
 
     def __init__(self):
         self._collections = {}

@@ -341,6 +341,11 @@ class NetworkDB:
     genuinely unknowable without server-side request ids.
     """
 
+    #: A count is one small request/reply, vastly cheaper than shipping the
+    #: full trial history over the wire (the producer's count-gated sync
+    #: keys on this).
+    cheap_counts = True
+
     def __init__(
         self, host="127.0.0.1", port=8765, timeout=60.0, idle_probe=1.0,
         secret=None,

@@ -97,6 +97,10 @@ def sqlite_path_selected(path):
 class SQLiteDB:
     """AbstractDB-contract database over a single SQLite file."""
 
+    #: Counts/targeted queries are SQL-side — no full-DB reload per op
+    #: (the producer's count-gated sync keys on this).
+    cheap_counts = True
+
     def __init__(self, path, timeout=60.0):
         self._path = str(path)
         self._timeout = float(timeout)
@@ -388,6 +392,21 @@ class SQLiteDB:
                 "SELECT COUNT(*) FROM docs WHERE collection = ?", (collection,)
             ).fetchone()
             return n
+        clauses, params = self._sql_prefilter(query)
+        if len(clauses) == len(query):
+            # Every condition was pushed to SQL, so COUNT(*) decides exactly
+            # — no JSON parse per row.  The producer's count-gated sync
+            # calls this every round with {experiment, status}, both
+            # pushable.
+            sql = (
+                "SELECT COUNT(*) FROM docs WHERE collection = ? AND "
+                + " AND ".join(clauses)
+            )
+            try:
+                (n,) = conn.execute(sql, (collection, *params)).fetchone()
+                return n
+            except sqlite3.OperationalError:
+                pass  # non-finite JSON token mid-scan: fall through
         return sum(
             1
             for doc in self._scan_iter(conn, collection, query)

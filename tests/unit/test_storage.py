@@ -840,3 +840,37 @@ def _net_worker_reserve_batched(host, port, out_queue):
 def test_network_concurrent_batched_reservation_across_processes():
     """The PIPELINED batch claims race exactly like per-op ones."""
     _run_network_reservation_race(_net_worker_reserve_batched)
+
+
+def test_fetch_update_view_gates_and_orders(storage):
+    """The producer's sync snapshot: count-gated completed reads (on
+    cheap-count backends), completed view winning the dedup, and the same
+    (submit_time, id) order fetch_trials delivers."""
+    from orion_tpu.core.trial import Result
+
+    for i in range(4):
+        storage.register_trial(new_trial(i))
+    trials, n_completed = storage.fetch_update_view("exp-id")
+    assert [t.params["x"] for t in trials] == [
+        t.params["x"] for t in storage.fetch_trials(uid="exp-id")
+    ]
+    assert all(t.status == "new" for t in trials)
+    # Complete two; the view must re-read them exactly once per count move.
+    got = storage.reserve_trials("exp-id", 2)
+    for i, t in enumerate(got):
+        storage.update_completed_trial(t, [Result("o", "objective", float(i))])
+    cheap = getattr(storage.db, "cheap_counts", False)
+    trials2, n2 = storage.fetch_update_view("exp-id", n_completed)
+    statuses = sorted(t.status for t in trials2)
+    assert statuses == ["completed", "completed", "new", "new"]
+    if cheap:
+        assert n2 == 2
+        # Gate closed: completed drop out of the view, non-completed stay.
+        trials3, n3 = storage.fetch_update_view("exp-id", n2)
+        assert n3 == n2
+        assert sorted(t.status for t in trials3) == ["new", "new"]
+    else:
+        assert n2 == -1  # full-fetch backends never gate
+    # Order invariant on the full view: submit_time then id.
+    order = [(t.submit_time, str(t.id)) for t in trials2]
+    assert order == sorted(order)
