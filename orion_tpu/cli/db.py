@@ -67,6 +67,40 @@ def add_subparser(subparsers):
     )
     copy_p.set_defaults(func=main_copy)
 
+    dump_p = sub.add_parser(
+        "dump",
+        help="export every document as JSON lines (backup / migration)",
+    )
+    dump_p.add_argument(
+        "--src", required=True,
+        help="storage to export: a DB file path, or host:port",
+    )
+    dump_p.add_argument(
+        "--out", default="-",
+        help="output file (default '-': stdout)",
+    )
+    dump_p.set_defaults(func=main_dump)
+
+    load_p = sub.add_parser(
+        "load",
+        help="import documents from a dump file (orion-tpu JSONL, or a "
+        "mongoexport --jsonArray file from a reference Oríon deployment)",
+    )
+    load_p.add_argument(
+        "--src", required=True,
+        help="dump file: `db dump` JSONL, raw-JSONL, or a JSON array",
+    )
+    load_p.add_argument(
+        "--dst", required=True,
+        help="destination storage: a DB file path, or host:port",
+    )
+    load_p.add_argument(
+        "--collection", default=None,
+        help="collection for files of raw documents (mongoexport output); "
+        "not needed for `db dump` files, which carry the collection per line",
+    )
+    load_p.set_defaults(func=main_load)
+
     test_p = sub.add_parser("test", help="run staged storage checks")
     _common(test_p)
     test_p.set_defaults(func=main_test)
@@ -143,52 +177,275 @@ def _unique_key(doc, fields):
         return repr([_get_path(doc, f)[1] for f in fields])
 
 
+def main_dump(args):
+    """Export every collection as JSON lines: ``{"collection": c, "doc": d}``
+    per line — the lossless, diffable interchange format ``db load``
+    re-imports (and the backup story for every backend, network included)."""
+    import contextlib
+    import json
+    import sys
+
+    from orion_tpu.storage.base import create_storage
+    from orion_tpu.storage.documents import json_default
+
+    config = _copy_spec_to_config(args.src)
+    if "path" in config and not os.path.exists(config["path"]):
+        # create_storage would silently CREATE an empty DB here — and a
+        # typo'd path would then truncate --out over the previous backup
+        # while reporting success.
+        print(f"ERROR: source database {args.src!r} does not exist",
+              file=sys.stderr)
+        return 1
+    src = create_storage(config)
+    with contextlib.ExitStack() as stack:
+        if args.out == "-":
+            out = sys.stdout
+        else:
+            out = stack.enter_context(open(args.out, "w"))
+        n = 0
+        for collection in _COPY_COLLECTIONS:
+            for doc in src.db.read(collection):
+                out.write(
+                    json.dumps(
+                        {"collection": collection, "doc": doc},
+                        default=json_default,
+                    )
+                    + "\n"
+                )
+                n += 1
+    if args.out != "-":
+        print(f"dumped {n} documents to {args.out}")
+    return 0
+
+
+def _denormalize_mongo(value):
+    """Strip Mongo extended-JSON wrappers so reference-Oríon exports load as
+    plain documents: ``{"$oid": s}`` -> s, ``{"$date": ...}`` -> epoch
+    seconds (float — this framework's timestamp convention), and the
+    ``$number*`` scalar wrappers -> python numbers."""
+    if isinstance(value, dict):
+        if set(value) == {"$oid"}:
+            return str(value["$oid"])
+        if set(value) == {"$date"}:
+            inner = value["$date"]
+            if isinstance(inner, dict) and set(inner) == {"$numberLong"}:
+                return int(inner["$numberLong"]) / 1000.0
+            if isinstance(inner, (int, float)):
+                return inner / 1000.0  # epoch millis
+            import datetime
+
+            return datetime.datetime.fromisoformat(
+                str(inner).replace("Z", "+00:00")
+            ).timestamp()
+        if set(value) == {"$numberLong"} or set(value) == {"$numberInt"}:
+            return int(next(iter(value.values())))
+        if set(value) == {"$numberDouble"} or set(value) == {"$numberDecimal"}:
+            return float(next(iter(value.values())))
+        return {k: _denormalize_mongo(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_denormalize_mongo(v) for v in value]
+    return value
+
+
+def _iter_dump_docs(path, default_collection):
+    """Yield (collection, doc) from a dump file: `db dump` JSONL lines
+    carrying their collection, raw-JSONL documents, or one JSON array
+    (mongoexport --jsonArray).  Raw forms need --collection."""
+    import json
+
+    from orion_tpu.utils.exceptions import CheckError
+
+    with open(path) as handle:
+        head = handle.read(1)
+        handle.seek(0)
+        if head == "[":
+            if default_collection is None:
+                raise CheckError(
+                    "this file is a raw JSON array of documents; pass "
+                    "--collection (experiments/trials/lying_trials) to say "
+                    "where they belong"
+                )
+            for doc in json.load(handle):
+                yield default_collection, _denormalize_mongo(doc)
+            return
+        for line_no, line in enumerate(handle, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except json.JSONDecodeError as exc:
+                raise CheckError(f"{path}:{line_no}: not JSON: {exc}") from exc
+            if (
+                isinstance(entry, dict)
+                and set(entry) == {"collection", "doc"}
+            ):
+                # Our own dump format: already plain documents — running the
+                # Mongo denormalizer over them would mangle any legitimate
+                # value shaped like a wrapper (a user metadata dict whose
+                # only key is "$date"), breaking dump->load losslessness.
+                yield entry["collection"], entry["doc"]
+            elif default_collection is not None:
+                yield default_collection, _denormalize_mongo(entry)
+            else:
+                raise CheckError(
+                    f"{path}:{line_no}: raw document without --collection "
+                    "(db-dump lines carry {'collection': ..., 'doc': ...})"
+                )
+
+
+def _strip_id(doc):
+    return {k: v for k, v in doc.items() if k != "_id"}
+
+
+def _plan_merge(dst, docs_by_collection):
+    """Plan-before-write merge shared by ``db copy`` and ``db load``:
+    returns ``(plan, conflicts)`` where plan is
+    ``[(collection, missing_docs, present_count), ...]``.
+
+    A document is *present* (idempotent no-op) when the destination — or an
+    earlier occurrence in the same source — already holds it with identical
+    content; it is a *conflict* when the same _id (or the same
+    unique-index key: experiments sharing name/version/user under distinct
+    _ids) maps to DIFFERENT content.  Conflicts must abort before any
+    write: the write phase would otherwise raise mid-batch with part of
+    the source applied.  Documents WITHOUT an _id dedup by full content
+    against the destination's _id-stripped documents (trials have no
+    unique index to catch a re-insert)."""
+    from orion_tpu.storage.base import INDEX_SPECS
+    from orion_tpu.storage.documents import dumps_canonical
+
+    unique_fields = {
+        collection: fields for collection, fields, unique in INDEX_SPECS if unique
+    }
+    plan, conflicts = [], 0
+    for collection, docs in docs_by_collection.items():
+        fields = unique_fields.get(collection)
+        existing = {}
+        existing_content = set()
+        unique_seen = set()
+        for doc in dst.db.read(collection):
+            if "_id" in doc:
+                existing[doc["_id"]] = doc
+            try:
+                existing_content.add(dumps_canonical(_strip_id(doc)))
+            except TypeError:
+                pass
+            if fields:
+                unique_seen.add(_unique_key(doc, fields))
+        first_by_id = {}
+        missing, present = [], 0
+        for doc in docs:
+            _id = doc.get("_id")
+            if _id is not None and _id in first_by_id:
+                # Repeated inside the source (concatenated dumps): same
+                # content merges, different content is a real conflict.
+                if _same_content(first_by_id[_id], doc):
+                    present += 1
+                else:
+                    conflicts += 1
+                continue
+            if _id is not None:
+                first_by_id[_id] = doc
+            other = existing.get(_id) if _id is not None else None
+            if other is not None:
+                if _same_content(other, doc):
+                    present += 1
+                else:
+                    conflicts += 1
+                continue
+            if _id is None:
+                try:
+                    key = dumps_canonical(doc)
+                except TypeError:
+                    key = None
+                if key is not None and key in existing_content:
+                    present += 1
+                    continue
+                if key is not None:
+                    existing_content.add(key)
+            if fields is not None:
+                key = _unique_key(doc, fields)
+                if key in unique_seen:
+                    conflicts += 1
+                    continue
+                unique_seen.add(key)
+            missing.append(doc)
+        plan.append((collection, missing, present))
+    return plan, conflicts
+
+
+def main_load(args):
+    """Import a dump into a destination storage; duplicate documents with
+    identical content merge idempotently, differing content aborts before
+    anything is written (same contract as ``db copy``)."""
+    import sys
+
+    from orion_tpu.storage.base import create_storage
+    from orion_tpu.utils.exceptions import CheckError, DuplicateKeyError
+
+    if args.collection is not None and args.collection not in _COPY_COLLECTIONS:
+        print(
+            f"ERROR: unknown collection {args.collection!r}; expected one of "
+            f"{_COPY_COLLECTIONS}",
+            file=sys.stderr,
+        )
+        return 1
+    dst = create_storage(_copy_spec_to_config(args.dst))
+    by_collection = {}
+    try:
+        for collection, doc in _iter_dump_docs(args.src, args.collection):
+            if collection not in _COPY_COLLECTIONS:
+                raise CheckError(f"unknown collection {collection!r} in dump")
+            by_collection.setdefault(collection, []).append(doc)
+    except OSError as exc:
+        print(f"ERROR: cannot read {args.src!r}: {exc}", file=sys.stderr)
+        return 1
+    plan, conflicts = _plan_merge(dst, by_collection)
+    if conflicts:
+        print(
+            f"ERROR: {conflicts} document(s) collide (same _id or same "
+            "experiment name/version/user with DIFFERENT content) — "
+            "NOTHING was loaded.  Bump the version or rename one side, "
+            "then re-run.",
+            file=sys.stderr,
+        )
+        return 1
+    for collection, missing, present in plan:
+        if missing:
+            try:
+                dst.db.write(collection, missing)
+            except DuplicateKeyError as exc:
+                print(
+                    f"ERROR: destination changed during the load "
+                    f"({collection}: {exc}) — the load is incomplete; "
+                    "re-run to merge idempotently.",
+                    file=sys.stderr,
+                )
+                return 1
+        print(f"{collection}: loaded {len(missing)}, already present {present}")
+    return 0
+
+
 def main_copy(args):
     import sys
 
-    from orion_tpu.storage.base import INDEX_SPECS, create_storage
+    from orion_tpu.storage.base import create_storage
     from orion_tpu.utils.exceptions import DuplicateKeyError
 
     src = create_storage(_copy_spec_to_config(args.src))
     dst = create_storage(_copy_spec_to_config(args.dst))
-    unique_fields = {
-        collection: fields for collection, fields, unique in INDEX_SPECS if unique
-    }
-    # Plan everything BEFORE writing anything: a conflicting experiment id
-    # must abort the whole copy, or its src trials (carrying experiment=id)
-    # would attach to the unrelated dst experiment.
-    plan, conflicts = [], 0
-    for collection in _COPY_COLLECTIONS:
-        fields = unique_fields.get(collection)
-        existing = {}
-        unique_seen = set()
-        for doc in dst.db.read(collection):
-            existing[doc["_id"]] = doc
-            if fields:
-                unique_seen.add(_unique_key(doc, fields))
-        missing, present = [], 0
-        for doc in src.db.read(collection):
-            other = existing.get(doc["_id"])
-            if other is None:
-                # Distinct _ids can still collide on a unique index (the same
-                # experiment name/version/user created independently on both
-                # sides, or legacy duplicates within src): the write phase
-                # would raise mid-batch, so count it as a conflict now,
-                # while nothing has been written.
-                if fields is not None:
-                    key = _unique_key(doc, fields)
-                    if key in unique_seen:
-                        conflicts += 1
-                        continue
-                    unique_seen.add(key)
-                missing.append(doc)
-            elif _same_content(other, doc):
-                present += 1  # idempotent: re-running a copy merges
-            else:
-                # Same _id, different content: legacy auto-increment ids can
-                # collide across unrelated databases.
-                conflicts += 1
-        plan.append((collection, missing, present))
+    # Plan everything BEFORE writing anything (shared with `db load`): a
+    # conflicting experiment id must abort the whole copy, or its src
+    # trials (carrying experiment=id) would attach to the unrelated dst
+    # experiment.
+    plan, conflicts = _plan_merge(
+        dst,
+        {
+            collection: src.db.read(collection)
+            for collection in _COPY_COLLECTIONS
+        },
+    )
     if conflicts:
         print(
             f"ERROR: {conflicts} document(s) collide with the destination "

@@ -582,3 +582,181 @@ def test_sqlite_routing_treats_empty_file_as_new(tmp_path):
     other = tmp_path / "db.pkl"
     other.touch()
     assert not sqlite_path_selected(str(other))
+
+
+# --- db dump / db load ------------------------------------------------------
+
+
+def _seed_storage(path):
+    st = create_storage({"type": "sqlite", "path": str(path)})
+    from orion_tpu.core.trial import Result, Trial
+
+    st.create_experiment({"name": "dmp", "version": 1, "metadata": {"user": "u"}})
+    exp = st.fetch_experiments({"name": "dmp"})[0]
+    for i in range(3):
+        st.register_trial(
+            Trial(experiment=exp["_id"], params={"/x": float(i)})
+        )
+    t = st.reserve_trial(exp["_id"])
+    st.update_completed_trial(t, [Result("o", "objective", 0.25)])
+    return st, exp
+
+
+def test_db_dump_load_roundtrip(tmp_path, capsys):
+    """dump -> load into a fresh backend reproduces every document; a second
+    load is an idempotent no-op."""
+    src_path = tmp_path / "src.sqlite"
+    _seed_storage(src_path)
+    dump = tmp_path / "dump.jsonl"
+    assert cli_main(["db", "dump", "--src", str(src_path), "--out", str(dump)]) == 0
+    assert cli_main(
+        ["db", "load", "--src", str(dump), "--dst", str(tmp_path / "dst.sqlite")]
+    ) == 0
+    dst = create_storage({"type": "sqlite", "path": str(tmp_path / "dst.sqlite")})
+    exp = dst.fetch_experiments({"name": "dmp"})[0]
+    trials = dst.fetch_trials(uid=exp["_id"])
+    assert len(trials) == 3
+    assert sum(1 for t in trials if t.status == "completed") == 1
+    # Idempotent merge.
+    assert cli_main(
+        ["db", "load", "--src", str(dump), "--dst", str(tmp_path / "dst.sqlite")]
+    ) == 0
+    assert len(dst.fetch_trials(uid=exp["_id"])) == 3
+    out = capsys.readouterr().out
+    assert "already present" in out
+
+
+def test_db_load_mongoexport_array(tmp_path):
+    """A mongoexport --jsonArray file (Mongo extended JSON: $oid/$date
+    wrappers) loads with --collection, normalized to this framework's plain
+    documents — the reference-Oríon migration path docs/design.md names."""
+    import json
+
+    exps = [
+        {
+            "_id": {"$oid": "64b1f0c2e4b0a1a2b3c4d5e6"},
+            "name": "legacy",
+            "version": 1,
+            "metadata": {
+                "user": "u",
+                "datetime": {"$date": "2023-07-14T12:00:00Z"},
+            },
+        }
+    ]
+    path = tmp_path / "experiments.json"
+    path.write_text(json.dumps(exps))
+    dst = tmp_path / "dst.sqlite"
+    assert cli_main(
+        ["db", "load", "--src", str(path), "--dst", str(dst),
+         "--collection", "experiments"]
+    ) == 0
+    st = create_storage({"type": "sqlite", "path": str(dst)})
+    exp = st.fetch_experiments({"name": "legacy"})[0]
+    assert exp["_id"] == "64b1f0c2e4b0a1a2b3c4d5e6"
+    assert isinstance(exp["metadata"]["datetime"], float)  # epoch seconds
+
+
+def test_db_load_conflict_aborts_before_writing(tmp_path, capsys):
+    """Same _id with different content aborts the WHOLE load."""
+    import json
+
+    src_path = tmp_path / "src.sqlite"
+    _, exp = _seed_storage(src_path)
+    dump = tmp_path / "dump.jsonl"
+    assert cli_main(["db", "dump", "--src", str(src_path), "--out", str(dump)]) == 0
+    dst_path = tmp_path / "dst.sqlite"
+    dst = create_storage({"type": "sqlite", "path": str(dst_path)})
+    dst.create_experiment(
+        {"_id": exp["_id"], "name": "OTHER", "version": 9, "metadata": {"user": "x"}}
+    )
+    rc = cli_main(["db", "load", "--src", str(dump), "--dst", str(dst_path)])
+    assert rc == 1
+    assert "NOTHING was loaded" in capsys.readouterr().err
+    # The conflicting load wrote no trials.
+    assert dst.fetch_trials(uid=exp["_id"]) == []
+
+
+def test_db_load_raw_lines_require_collection(tmp_path, capsys):
+    path = tmp_path / "raw.jsonl"
+    path.write_text('{"name": "n", "version": 1}\n')
+    rc = cli_main(["db", "load", "--src", str(path),
+                   "--dst", str(tmp_path / "d.sqlite")])
+    assert rc == 1
+    assert "collection" in capsys.readouterr().err
+
+
+def test_db_dump_refuses_missing_source(tmp_path, capsys):
+    """A typo'd --src must not create an empty DB and truncate the backup."""
+    out = tmp_path / "backup.jsonl"
+    out.write_text("precious\n")
+    rc = cli_main(["db", "dump", "--src", str(tmp_path / "typo.sqlite"),
+                   "--out", str(out)])
+    assert rc == 1
+    assert "does not exist" in capsys.readouterr().err
+    assert out.read_text() == "precious\n"  # prior backup untouched
+    assert not (tmp_path / "typo.sqlite").exists()
+
+
+def test_db_load_unique_index_collision_detected_in_plan(tmp_path, capsys):
+    """Distinct _ids sharing an experiment's name/version/user must abort in
+    the PLAN phase with the actionable message, not die mid-write."""
+    src_path = tmp_path / "src.sqlite"
+    _seed_storage(src_path)
+    dump = tmp_path / "dump.jsonl"
+    assert cli_main(["db", "dump", "--src", str(src_path), "--out", str(dump)]) == 0
+    dst_path = tmp_path / "dst.sqlite"
+    dst = create_storage({"type": "sqlite", "path": str(dst_path)})
+    dst.create_experiment(
+        {"_id": "OTHER-ID", "name": "dmp", "version": 1, "metadata": {"user": "u"}}
+    )
+    rc = cli_main(["db", "load", "--src", str(dump), "--dst", str(dst_path)])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "NOTHING was loaded" in err and "version" in err
+    assert dst.fetch_trials(uid="OTHER-ID") == []
+
+
+def test_db_load_concatenated_dumps_merge(tmp_path):
+    """cat day1.jsonl day2.jsonl: repeated identical documents merge as
+    'already present', they are not conflicts."""
+    src_path = tmp_path / "src.sqlite"
+    _seed_storage(src_path)
+    dump = tmp_path / "dump.jsonl"
+    assert cli_main(["db", "dump", "--src", str(src_path), "--out", str(dump)]) == 0
+    doubled = tmp_path / "doubled.jsonl"
+    doubled.write_text(dump.read_text() + dump.read_text())
+    dst_path = tmp_path / "dst.sqlite"
+    assert cli_main(["db", "load", "--src", str(doubled), "--dst", str(dst_path)]) == 0
+    dst = create_storage({"type": "sqlite", "path": str(dst_path)})
+    exp = dst.fetch_experiments({"name": "dmp"})[0]
+    assert len(dst.fetch_trials(uid=exp["_id"])) == 3
+
+
+def test_db_load_idless_raw_docs_dedup_by_content(tmp_path):
+    """Raw JSONL documents without _id must not duplicate on re-load."""
+    raw = tmp_path / "raw.jsonl"
+    raw.write_text('{"experiment": "e1", "params": {"/x": 1.0}, "status": "new"}\n')
+    dst_path = tmp_path / "dst.sqlite"
+    for _ in range(2):
+        assert cli_main(["db", "load", "--src", str(raw), "--dst", str(dst_path),
+                         "--collection", "trials"]) == 0
+    dst = create_storage({"type": "sqlite", "path": str(dst_path)})
+    assert len(dst.fetch_trials(uid="e1")) == 1
+
+
+def test_db_dump_load_preserves_wrapper_shaped_values(tmp_path):
+    """Our own dump format is lossless: a legitimate document value shaped
+    like a Mongo wrapper must NOT be rewritten on load."""
+    src_path = tmp_path / "src.sqlite"
+    st = create_storage({"type": "sqlite", "path": str(src_path)})
+    st.create_experiment(
+        {"name": "wrap", "version": 1,
+         "metadata": {"user": "u", "odd": {"$date": 123}}}
+    )
+    dump = tmp_path / "dump.jsonl"
+    assert cli_main(["db", "dump", "--src", str(src_path), "--out", str(dump)]) == 0
+    dst_path = tmp_path / "dst.sqlite"
+    assert cli_main(["db", "load", "--src", str(dump), "--dst", str(dst_path)]) == 0
+    dst = create_storage({"type": "sqlite", "path": str(dst_path)})
+    exp = dst.fetch_experiments({"name": "wrap"})[0]
+    assert exp["metadata"]["odd"] == {"$date": 123}
