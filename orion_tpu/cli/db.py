@@ -8,6 +8,7 @@ to the current schema (indexes + config backfill, reference
 `cli/db/upgrade.py:96-183`).
 """
 
+import contextlib
 import os
 
 import yaml
@@ -177,31 +178,37 @@ def _unique_key(doc, fields):
         return repr([_get_path(doc, f)[1] for f in fields])
 
 
+def _source_storage_or_error(spec):
+    """Open a READ source: a nonexistent file path is an error, never a
+    freshly-created empty DB — `db dump --src typo.sqlite` would otherwise
+    truncate the backup (and `db copy` report a successful 0-doc copy)
+    while the user believes their data was exported."""
+    import sys
+
+    from orion_tpu.storage.base import create_storage
+
+    config = _copy_spec_to_config(spec)
+    if "path" in config and not os.path.exists(config["path"]):
+        print(f"ERROR: source database {spec!r} does not exist", file=sys.stderr)
+        return None
+    return create_storage(config)
+
+
 def main_dump(args):
     """Export every collection as JSON lines: ``{"collection": c, "doc": d}``
     per line — the lossless, diffable interchange format ``db load``
     re-imports (and the backup story for every backend, network included)."""
-    import contextlib
     import json
     import sys
+    import tempfile
 
-    from orion_tpu.storage.base import create_storage
     from orion_tpu.storage.documents import json_default
 
-    config = _copy_spec_to_config(args.src)
-    if "path" in config and not os.path.exists(config["path"]):
-        # create_storage would silently CREATE an empty DB here — and a
-        # typo'd path would then truncate --out over the previous backup
-        # while reporting success.
-        print(f"ERROR: source database {args.src!r} does not exist",
-              file=sys.stderr)
+    src = _source_storage_or_error(args.src)
+    if src is None:
         return 1
-    src = create_storage(config)
-    with contextlib.ExitStack() as stack:
-        if args.out == "-":
-            out = sys.stdout
-        else:
-            out = stack.enter_context(open(args.out, "w"))
+
+    def _write_all(out):
         n = 0
         for collection in _COPY_COLLECTIONS:
             for doc in src.db.read(collection):
@@ -213,8 +220,25 @@ def main_dump(args):
                     + "\n"
                 )
                 n += 1
-    if args.out != "-":
-        print(f"dumped {n} documents to {args.out}")
+        return n
+
+    if args.out == "-":
+        _write_all(sys.stdout)
+        return 0
+    # Atomic replace: a mid-dump failure (unserializable legacy document,
+    # network source dropping) must never have truncated the previous
+    # backup already.
+    out_dir = os.path.dirname(os.path.abspath(args.out)) or "."
+    fd, tmp_path = tempfile.mkstemp(dir=out_dir, suffix=".dump-partial")
+    try:
+        with os.fdopen(fd, "w") as out:
+            n = _write_all(out)
+        os.replace(tmp_path, args.out)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp_path)
+        raise
+    print(f"dumped {n} documents to {args.out}")
     return 0
 
 
@@ -322,17 +346,29 @@ def _plan_merge(dst, docs_by_collection):
     for collection, docs in docs_by_collection.items():
         fields = unique_fields.get(collection)
         existing = {}
-        existing_content = set()
         unique_seen = set()
-        for doc in dst.db.read(collection):
+        dst_docs = list(dst.db.read(collection))
+        for doc in dst_docs:
             if "_id" in doc:
                 existing[doc["_id"]] = doc
-            try:
-                existing_content.add(dumps_canonical(_strip_id(doc)))
-            except TypeError:
-                pass
             if fields:
                 unique_seen.add(_unique_key(doc, fields))
+        # Content keys support only the raw-JSONL id-less path; built
+        # lazily — `db copy` and db-dump loads always carry _ids, and
+        # canonical-JSON-encoding every destination document would be O(N)
+        # wasted work on their common path.
+        existing_content = None
+
+        def content_keys():
+            nonlocal existing_content
+            if existing_content is None:
+                existing_content = set()
+                for doc in dst_docs:
+                    try:
+                        existing_content.add(dumps_canonical(_strip_id(doc)))
+                    except TypeError:
+                        pass
+            return existing_content
         first_by_id = {}
         missing, present = [], 0
         for doc in docs:
@@ -359,11 +395,11 @@ def _plan_merge(dst, docs_by_collection):
                     key = dumps_canonical(doc)
                 except TypeError:
                     key = None
-                if key is not None and key in existing_content:
+                if key is not None and key in content_keys():
                     present += 1
                     continue
                 if key is not None:
-                    existing_content.add(key)
+                    content_keys().add(key)
             if fields is not None:
                 key = _unique_key(doc, fields)
                 if key in unique_seen:
@@ -433,7 +469,9 @@ def main_copy(args):
     from orion_tpu.storage.base import create_storage
     from orion_tpu.utils.exceptions import DuplicateKeyError
 
-    src = create_storage(_copy_spec_to_config(args.src))
+    src = _source_storage_or_error(args.src)
+    if src is None:
+        return 1
     dst = create_storage(_copy_spec_to_config(args.dst))
     # Plan everything BEFORE writing anything (shared with `db load`): a
     # conflicting experiment id must abort the whole copy, or its src

@@ -43,13 +43,42 @@ def index_key(doc, fields):
     the key function every backend's unique-index enforcement agrees on."""
     return dumps_canonical([_get_path(doc, f)[1] for f in fields])
 
+def _ordered(op):
+    """Range operators never raise on incomparable types — they just don't
+    match.  A list-valued field meeting ``{$gte: 2}`` must behave the same
+    on every backend; letting TypeError escape made the in-process backends
+    raise it while the network server translated it into a DatabaseError —
+    a per-backend divergence (found by the differential fuzzer) and a way
+    for one malformed query to break a shared server's request loop."""
+
+    def safe(doc_val, qv):
+        if doc_val is None:
+            return False
+        try:
+            # bool() inside the try: numpy-array field values make the
+            # comparison return an elementwise array whose truthiness
+            # raises LATER (outside any guard) — force the ValueError here.
+            return bool(op(doc_val, qv))
+        except (TypeError, ValueError):
+            return False
+
+    return safe
+
+
+def _in(doc_val, qv):
+    try:
+        return doc_val in qv
+    except TypeError:
+        return False
+
+
 _OPS = {
     "$ne": lambda doc_val, qv: doc_val != qv,
-    "$in": lambda doc_val, qv: doc_val in qv,
-    "$gte": lambda doc_val, qv: doc_val is not None and doc_val >= qv,
-    "$gt": lambda doc_val, qv: doc_val is not None and doc_val > qv,
-    "$lte": lambda doc_val, qv: doc_val is not None and doc_val <= qv,
-    "$lt": lambda doc_val, qv: doc_val is not None and doc_val < qv,
+    "$in": _in,
+    "$gte": _ordered(lambda a, b: a >= b),
+    "$gt": _ordered(lambda a, b: a > b),
+    "$lte": _ordered(lambda a, b: a <= b),
+    "$lt": _ordered(lambda a, b: a < b),
 }
 
 

@@ -760,3 +760,11 @@ def test_db_dump_load_preserves_wrapper_shaped_values(tmp_path):
     dst = create_storage({"type": "sqlite", "path": str(dst_path)})
     exp = dst.fetch_experiments({"name": "wrap"})[0]
     assert exp["metadata"]["odd"] == {"$date": 123}
+
+
+def test_db_copy_refuses_missing_source(tmp_path, capsys):
+    rc = cli_main(["db", "copy", "--src", str(tmp_path / "typo.pkl"),
+                   "--dst", str(tmp_path / "d.sqlite")])
+    assert rc == 1
+    assert "does not exist" in capsys.readouterr().err
+    assert not (tmp_path / "typo.pkl").exists()

@@ -874,3 +874,19 @@ def test_fetch_update_view_gates_and_orders(storage):
     # Order invariant on the full view: submit_time then id.
     order = [(t.submit_time, str(t.id)) for t in trials2]
     assert order == sorted(order)
+
+
+def test_range_query_on_incomparable_values_never_raises():
+    """A malformed range query (list/numpy field vs scalar bound) is 'no
+    match' on EVERY backend — not a TypeError/ValueError that crashes an
+    in-process worker while the network server translates it into a
+    different error class (differential-fuzzer find)."""
+    import numpy as np
+
+    db = MemoryDB()
+    db.write("c", {"_id": 1, "a": [2, 1]})
+    db.write("c", {"_id": 2, "a": np.array([1, 2, 3])})
+    db.write("c", {"_id": 3, "a": 5})
+    assert [d["_id"] for d in db.read("c", {"a": {"$gte": 2}})] == [3]
+    assert db.count("c", {"a": {"$lt": 10}}) == 1
+    assert db.read("c", {"a": {"$in": 7}}) == []  # non-container $in operand
