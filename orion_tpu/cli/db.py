@@ -233,6 +233,18 @@ def main_dump(args):
     try:
         with os.fdopen(fd, "w") as out:
             n = _write_all(out)
+        # mkstemp creates 0600; preserve an existing backup's mode (a
+        # group-readable file synced by another user must stay readable),
+        # else the umask default a plain open() would have produced.
+        import stat
+
+        if os.path.exists(args.out):
+            mode = stat.S_IMODE(os.stat(args.out).st_mode)
+        else:
+            current_umask = os.umask(0)
+            os.umask(current_umask)
+            mode = 0o666 & ~current_umask
+        os.chmod(tmp_path, mode)
         os.replace(tmp_path, args.out)
     except BaseException:
         with contextlib.suppress(OSError):

@@ -67,8 +67,8 @@ def _ordered(op):
 
 def _in(doc_val, qv):
     try:
-        return doc_val in qv
-    except TypeError:
+        return bool(doc_val in qv)
+    except (TypeError, ValueError):
         return False
 
 
@@ -82,10 +82,30 @@ _OPS = {
 }
 
 
+def _plain_value(value):
+    """Numpy values normalize to their python list/scalar form BEFORE any
+    comparison, so the in-process backends judge queries on exactly what
+    the sqlite/network backends stored (those serialize through JSON on
+    write).  Without this, {'a': np.array(...)} matched {'a': {'$ne': 2}}
+    differently per backend — and equality raised ValueError at
+    array-truthiness time (differential-fuzzer find, extended by review)."""
+    tolist = getattr(value, "tolist", None)
+    if callable(tolist) and not isinstance(value, (str, bytes, list, dict)):
+        try:
+            return value.tolist()
+        except Exception:  # pragma: no cover - exotic array-likes
+            return value
+    return value
+
+
 def _match_value(doc_val, query_val):
+    doc_val = _plain_value(doc_val)
     if isinstance(query_val, dict) and any(k.startswith("$") for k in query_val):
         return all(_OPS[op](doc_val, qv) for op, qv in query_val.items())
-    return doc_val == query_val
+    try:
+        return bool(doc_val == query_val)
+    except ValueError:  # pragma: no cover - array-likes without tolist
+        return False
 
 
 def _matches(nested_doc, query):

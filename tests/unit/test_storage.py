@@ -890,3 +890,19 @@ def test_range_query_on_incomparable_values_never_raises():
     assert [d["_id"] for d in db.read("c", {"a": {"$gte": 2}})] == [3]
     assert db.count("c", {"a": {"$lt": 10}}) == 1
     assert db.read("c", {"a": {"$in": 7}}) == []  # non-container $in operand
+
+
+def test_numpy_field_values_match_like_their_list_form():
+    """Numpy values normalize before comparison, so in-process backends
+    agree with the JSON-serializing ones on EVERY operator (review find:
+    $ne/$in/equality still diverged after the range-op hardening)."""
+    import numpy as np
+
+    mem = MemoryDB()
+    mem.write("c", {"_id": 1, "a": np.array([1, 2, 3])})
+    mem.write("c", {"_id": 2, "a": np.float64(2.0)})
+    # Equality/$ne/$in judged on the list/scalar form — never a ValueError.
+    assert [d["_id"] for d in mem.read("c", {"a": [1, 2, 3]})] == [1]
+    assert [d["_id"] for d in mem.read("c", {"a": {"$ne": 2}})] == [1]
+    assert [d["_id"] for d in mem.read("c", {"a": {"$in": [2, 9]}})] == [2]
+    assert mem.count("c", {"a": 2}) == 1

@@ -66,6 +66,14 @@ def _apply(db, op, payload):
         if op == "read":
             docs = db.read("c", payload)
             return ("docs", sorted(dumps_canonical(d) for d in docs))
+        if op == "project":
+            query, projection = payload
+            docs = db.read("c", query, projection=projection)
+            return ("docs", sorted(dumps_canonical(d) for d in docs))
+        if op == "dotted":
+            query, dotted_update = payload
+            n = db.write("c", dotted_update, query=query)
+            return ("n", n)
         if op == "count":
             return ("n", db.count("c", payload))
         if op == "raw":  # read_and_write: result doc must match too
@@ -109,9 +117,21 @@ def test_backends_agree_on_random_programs(seed, tmp_path):
                 program.append(
                     ("update", (_random_query(rng), {"a": rng.randint(0, 5)}))
                 )
-            elif r < 0.7:
+            elif r < 0.66:
                 program.append(("read", _random_query(rng)))
-            elif r < 0.8:
+            elif r < 0.72:
+                program.append(
+                    ("project",
+                     (_random_query(rng),
+                      rng.choice([{"a": 1}, {"b.c": 1}, {"a": 1, "_id": 0}])))
+                )
+            elif r < 0.78:
+                # Dotted-path update: creates/overwrites a nested leaf.
+                program.append(
+                    ("dotted",
+                     (_random_query(rng), {"b.c": rng.randint(10, 12)}))
+                )
+            elif r < 0.84:
                 program.append(("count", _random_query(rng)))
             elif r < 0.9:
                 # Deterministic single-doc CAS: _id-targeted, so every
@@ -135,7 +155,7 @@ def test_backends_agree_on_random_programs(seed, tmp_path):
                     f"seed {seed} step {step} {op}: {name} returned {got!r}, "
                     f"oracle {expected!r} (payload {payload!r})"
                 )
-            if op in ("insert", "update", "raw", "remove"):
+            if op in ("insert", "update", "dotted", "raw", "remove"):
                 want = _canonical_state(oracle)
                 for name, db in backends.items():
                     if name == "memory":
