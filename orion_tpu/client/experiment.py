@@ -15,7 +15,7 @@ from orion_tpu.core.experiment import build_experiment
 from orion_tpu.core.producer import Producer
 from orion_tpu.core.trial import Result
 from orion_tpu.storage.base import create_storage
-from orion_tpu.utils.exceptions import WaitingForTrials
+from orion_tpu.utils.exceptions import AlgorithmExhausted, WaitingForTrials
 
 
 class ExperimentClient:
@@ -40,9 +40,22 @@ class ExperimentClient:
         while len(out) < num:
             got = self.experiment.reserve_trials(num - len(out))
             if not got:
-                self.producer.produce(num - len(out))
+                try:
+                    # Tell the producer how many reserved trials WE hold:
+                    # an opt-out must not wait on our own reservations (we
+                    # are the one who would complete them — deadlock), but
+                    # must still wait on other workers' in-flight trials.
+                    self.producer.produce(num - len(out), own_in_flight=len(out))
+                except AlgorithmExhausted:
+                    if out:
+                        # Hand back the partial batch; the next call (with
+                        # nothing reserved) re-raises for the caller to stop.
+                        return out
+                    raise
                 got = self.experiment.reserve_trials(num - len(out))
             if not got:
+                if out:
+                    return out  # partial batch: a finite algorithm ran dry
                 raise WaitingForTrials("could not reserve after producing")
             out.extend(got)
         return out
@@ -107,7 +120,11 @@ def optimize(
     n_done = 0
     while n_done < max_trials and not client.is_done:
         want = min(batch_size, max_trials - n_done)
-        trials = client.suggest(want)
+        try:
+            trials = client.suggest(want)
+        except AlgorithmExhausted:
+            # Finite algorithm ran dry before max_trials — a clean finish.
+            break
         if batch_eval is not None:
             space = experiment.space
             arrays = space.params_to_arrays([t.params for t in trials])

@@ -13,8 +13,12 @@ import logging
 import random as _random
 import time
 
-from orion_tpu.core.trial import Result, Trial
-from orion_tpu.utils.exceptions import DuplicateKeyError, SampleTimeout
+from orion_tpu.core.trial import RESERVABLE_STATUSES, Result, Trial
+from orion_tpu.utils.exceptions import (
+    AlgorithmExhausted,
+    DuplicateKeyError,
+    SampleTimeout,
+)
 
 log = logging.getLogger(__name__)
 
@@ -35,6 +39,8 @@ class Producer:
         self._observed_ids = set()  # replaces reference TrialsHistory dedup
         self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
         self.failure_count = 0
+        self._n_in_flight = 0  # status == reserved (someone is executing)
+        self._n_reservable = 0  # new/suspended/interrupted (worker can consume)
         self._pending_timings = []
         self._n_completed_seen = 0
         self._update_epoch = 0
@@ -88,6 +94,13 @@ class Producer:
             )
         completed = [t for t in trials if t.status == "completed" and t.objective]
         incomplete = [t for t in trials if not t.is_stopped]
+        # Exhaustion/backoff accounting counts THIS experiment's trials only:
+        # the EVC tree fetch includes the family's trials, which this worker
+        # can never reserve and whose completions feed ancestors, not us.
+        own_id = self.experiment.id
+        own = [t for t in trials if t.experiment == own_id]
+        self._n_in_flight = sum(t.status == "reserved" for t in own)
+        self._n_reservable = sum(t.status in RESERVABLE_STATUSES for t in own)
         self._update_algorithm(completed)
         self._update_naive_algorithm(incomplete)
         self._flush_timings()
@@ -149,8 +162,16 @@ class Producer:
         return lying
 
     # --- production ---------------------------------------------------------
-    def produce(self, pool_size=None):
-        """Register `pool_size` new trials (reference `producer.py:69-101`)."""
+    def produce(self, pool_size=None, own_in_flight=0):
+        """Register `pool_size` new trials (reference `producer.py:69-101`).
+
+        ``own_in_flight``: how many of the experiment's reserved trials THE
+        CALLER itself is holding.  An opt-out normally backs off while
+        reserved trials exist (their completions can revive the algorithm),
+        but waiting on the caller's own reservations would deadlock the
+        caller against itself (``ExperimentClient.suggest`` holding a
+        partial batch) — so the wait only applies when reserved trials
+        beyond the caller's own exist."""
         pool_size = pool_size or self.experiment.pool_size
         registered = 0
         start = time.time()
@@ -178,9 +199,34 @@ class Producer:
                         "suggest", time.perf_counter() - t0, len(suggested)
                     )
             if suggested is None:
-                log.debug("algorithm opted out of suggesting; backing off")
-                self.backoff()
-                continue
+                log.debug("algorithm opted out of suggesting")
+                # Re-sync first: the opt-out may come from a stale view.
+                self.update()
+                if registered or self._n_reservable:
+                    # The worker can make progress without new points —
+                    # consume what is already registered (this round's
+                    # partial batch or a concurrent producer's); exhaustion
+                    # re-fires on the next dry production round.
+                    break
+                if self._n_in_flight > own_in_flight:
+                    # Executing trials beyond the caller's own exist; their
+                    # completions may change the algorithm's state — wait.
+                    self._sleep_backoff()
+                    continue
+                t0 = time.perf_counter()
+                suggested = self.naive_algorithm.suggest(pool_size - registered)
+                self.algorithm.rng_key = self.naive_algorithm.rng_key
+                if suggested is None:
+                    # Nothing pending, nothing running, and a fresh-state
+                    # retry still opts out: no observation can ever arrive,
+                    # so the state producing this opt-out is final.
+                    raise AlgorithmExhausted(
+                        "algorithm opted out of suggesting with no trials "
+                        "in flight; the search space is exhausted"
+                    )
+                self._record_timing(
+                    "suggest", time.perf_counter() - t0, len(suggested)
+                )
             batch = [
                 Trial(params=params)
                 for params in suggested[: pool_size - registered]
@@ -284,6 +330,9 @@ class Producer:
     def backoff(self):
         """Re-sync with storage + jittered sleep (reference `producer.py:61-67`)."""
         self.update()
+        self._sleep_backoff()
+
+    def _sleep_backoff(self):
         sleep = max(0.0, _random.gauss(0.01 * (1 + self.failure_count), 0.005))
         time.sleep(min(sleep, 0.5))
         self.failure_count += 1

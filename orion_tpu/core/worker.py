@@ -15,7 +15,12 @@ import logging
 from orion_tpu.core.consumer import Consumer
 from orion_tpu.core.experiment import DEFAULT_HEARTBEAT, DEFAULT_MAX_IDLE_TIME
 from orion_tpu.core.producer import Producer
-from orion_tpu.utils.exceptions import BrokenExperiment, SampleTimeout, WaitingForTrials
+from orion_tpu.utils.exceptions import (
+    AlgorithmExhausted,
+    BrokenExperiment,
+    SampleTimeout,
+    WaitingForTrials,
+)
 
 log = logging.getLogger(__name__)
 
@@ -66,6 +71,16 @@ def workon(
             break
         try:
             trial = reserve_trial(experiment, producer)
+        except AlgorithmExhausted:
+            # A finite algorithm ran out of points with nothing in flight:
+            # every registered trial is consumed and no observation can
+            # change that — a clean end of the hunt, reached in milliseconds
+            # instead of idling out max_idle_time.
+            log.info(
+                "Algorithm for experiment %s is exhausted; stopping.",
+                experiment.name,
+            )
+            break
         except (SampleTimeout, WaitingForTrials):
             if experiment.is_done:
                 break

@@ -58,6 +58,16 @@ class SampleTimeout(OrionTPUError):
     """Algorithm failed to sample a new unique point within max_idle_time."""
 
 
+class AlgorithmExhausted(OrionTPUError):
+    """A finite algorithm opted out with no trials in flight anywhere.
+
+    Nothing can change its state (no pending observation exists and lies
+    have nothing to fantasize over), so the producer ends the hunt now
+    instead of burning ``max_idle_time`` (reference opt-out contract:
+    `src/orion/algo/base.py:142-163`, `src/orion/core/worker/producer.py:74-78`
+    back off forever; workers exit cleanly on this signal)."""
+
+
 class WaitingForTrials(OrionTPUError):
     """No trial could be reserved right now."""
 

@@ -48,9 +48,10 @@ def test_asha_end_to_end(tmp_path):
 # user's hunt.  Model-quality claims live in the benchmark presets.
 _FLAT_ROSTER = {
     "random": {},
-    # 12 >= max-trials: an exhausted grid makes the worker idle-wait out
-    # the sampler timeout before is_done fires (measured +50s of nothing).
-    "grid_search": {"n_values": 12},
+    # 6 < max-trials: the hunt must end cleanly (AlgorithmExhausted fast
+    # path) the moment the 6-point grid is consumed, not idle-wait out the
+    # sampler timeout.
+    "grid_search": {"n_values": 6},
     "tpe": {"n_init": 4, "n_candidates": 128},
     "cmaes": {"popsize": 6},
     "tpu_bo": {"n_init": 4, "n_candidates": 128, "fit_steps": 3},

@@ -96,6 +96,93 @@ def test_producer_duplicate_suggestion_times_out(experiment):
         producer.produce(1)
 
 
+def _grid_experiment(tmp_path=None, n_values=4, pool=4):
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage,
+        "grid-exp",
+        priors={"/x": "uniform(0, 10)"},
+        max_trials=100,
+        algorithms={"grid_search": {"n_values": n_values}},
+        strategy="NoParallelStrategy",
+        pool_size=pool,
+    )
+    return exp.instantiate()
+
+
+def test_exhausted_algorithm_ends_production_immediately():
+    """VERDICT r4 #5: a finite algorithm opting out with nothing in flight
+    must raise AlgorithmExhausted in milliseconds, not idle out
+    max_idle_time."""
+    import time as _time
+
+    from orion_tpu.utils.exceptions import AlgorithmExhausted
+
+    exp = _grid_experiment()
+    producer = Producer(exp, max_idle_time=60.0)
+    producer.update()
+    assert producer.produce(4) == 4
+    for trial in exp.fetch_trials():
+        complete(exp, trial, 1.0)
+    producer.update()
+    t0 = _time.perf_counter()
+    with pytest.raises(AlgorithmExhausted):
+        producer.produce(1)
+    assert _time.perf_counter() - t0 < 5.0  # fast path, not max_idle_time
+
+
+def test_exhausted_algorithm_waits_while_trials_are_in_flight():
+    """With a reserved trial still executing somewhere, exhaustion must NOT
+    fire — the completion could change the algorithm's state — so the old
+    SampleTimeout budget applies."""
+    exp = _grid_experiment()
+    producer = Producer(exp, max_idle_time=0.3)
+    producer.update()
+    assert producer.produce(4) == 4
+    trials = exp.fetch_trials()
+    for trial in trials[:3]:
+        complete(exp, trial, 1.0)
+    exp.storage.set_trial_status(trials[3], "reserved", was="new")
+    producer.update()
+    with pytest.raises(SampleTimeout):
+        producer.produce(1)
+
+
+def test_exhausted_algorithm_returns_partial_batch_first():
+    """A production round that DID register trials hands them to the worker
+    instead of raising; exhaustion fires on the next dry round."""
+    from orion_tpu.utils.exceptions import AlgorithmExhausted
+
+    exp = _grid_experiment(n_values=4)
+    producer = Producer(exp, max_idle_time=60.0)
+    producer.update()
+    assert producer.produce(3) == 3
+    for trial in exp.fetch_trials():
+        complete(exp, trial, 1.0)
+    producer.update()
+    # One grid point left; asking for 3 returns the partial batch of 1.
+    assert producer.produce(3) == 1
+    [last] = [t for t in exp.fetch_trials() if t.status == "new"]
+    complete(exp, last, 1.0)
+    producer.update()
+    with pytest.raises(AlgorithmExhausted):
+        producer.produce(1)
+
+
+def test_optimize_finishes_cleanly_on_exhausted_grid():
+    """Library loop: a grid smaller than max_trials ends the run cleanly."""
+    from orion_tpu.client.experiment import optimize
+
+    stats = optimize(
+        lambda p: (p["/x"] - 3.0) ** 2,
+        {"/x": "uniform(0, 10)"},
+        max_trials=50,
+        batch_size=4,
+        algorithm={"grid_search": {"n_values": 6}},
+    )
+    assert stats["trials_completed"] == 6
+
+
 def test_producer_lineage_parents(experiment):
     producer = Producer(experiment)
     producer.update()
