@@ -32,7 +32,7 @@ from orion_tpu.algo.tpu_bo import (
     copula_transform,
     local_subset_indices,
     run_suggest_step,
-    tr_update,
+    tr_update_batch,
 )
 from orion_tpu.parallel import device_mesh
 
@@ -74,6 +74,7 @@ class ASHABO(ASHA):
         tr_improve_tol=1e-3,
         tr_local_m=512,
         tr_perturb_dims=20,
+        tr_update_every=8,
         n_devices=None,
         use_mesh=False,
     ):
@@ -93,6 +94,7 @@ class ASHABO(ASHA):
             tr_length_max=tr_length_max, tr_succ_tol=tr_succ_tol,
             tr_fail_tol=tr_fail_tol, tr_improve_tol=tr_improve_tol,
             tr_local_m=tr_local_m, tr_perturb_dims=tr_perturb_dims,
+            tr_update_every=tr_update_every,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
@@ -115,6 +117,7 @@ class ASHABO(ASHA):
         self.tr_improve_tol = tr_improve_tol
         self.tr_local_m = tr_local_m
         self.tr_perturb_dims = tr_perturb_dims
+        self.tr_update_every = tr_update_every
         # Same mesh semantics as TPUBO: shard the candidate axis of the fused
         # suggest step over the devices (BASELINE config #5 names q=4096 on a
         # v5e-8 — the model-based variant must scale the same way).
@@ -186,13 +189,16 @@ class ASHABO(ASHA):
         # fidelities for the box signal (a better low-fid value still marks
         # progress).
         if self.trust_region and self._mf_y.shape[0] - len(yvals) >= self.n_init:
-            improved = batch_best < prev_best - self.tr_improve_tol * abs(prev_best)
-            self._tr_length, self._tr_succ, self._tr_fail = tr_update(
-                self._tr_length, self._tr_succ, self._tr_fail, improved,
+            # Cadence decoupled from batch size: big rounds are split into
+            # tr_update_every-sized sub-rounds (tr_update_batch docstring).
+            self._tr_length, self._tr_succ, self._tr_fail = tr_update_batch(
+                self._tr_length, self._tr_succ, self._tr_fail,
+                prev_best, y, chunk=self.tr_update_every,
                 succ_tol=self.tr_succ_tol, fail_tol=self.tr_fail_tol,
                 length_init=self.tr_length_init,
                 length_min=self.tr_length_min,
                 length_max=self.tr_length_max,
+                improve_tol=self.tr_improve_tol,
             )
 
     # --- model-based sampling -----------------------------------------------

@@ -219,6 +219,31 @@ def test_turbo_trust_region_lifecycle():
     assert algo._tr_length == 0.8  # collapsed below min -> restarted
 
 
+def test_tr_update_batch_decouples_cadence_from_batch_size():
+    """VERDICT r4 #2: one q=256 observe round must give the box q/chunk
+    adaptations, while batches <= chunk keep the exact per-round schedule."""
+    from orion_tpu.algo.tpu_bo import tr_update, tr_update_batch
+
+    kw = dict(succ_tol=3, fail_tol=2, length_init=0.8, length_min=0.01,
+              length_max=1.6)
+    # Small batch == single round: bitwise-identical to tr_update.
+    batched = tr_update_batch(0.8, 0, 0, 1.0, [2.0] * 8, chunk=8,
+                              improve_tol=1e-3, **kw)
+    single = tr_update(0.8, 0, 0, False, **kw)
+    assert batched == single
+    # A stagnant 64-point round at chunk=8 is 8 failing sub-rounds:
+    # fail_tol=2 halves the box 4 times (0.8 -> 0.05).
+    length, succ, fail = tr_update_batch(0.8, 0, 0, 1.0, [2.0] * 64, chunk=8,
+                                         improve_tol=1e-3, **kw)
+    assert length == 0.8 / 16
+    # An improving run: the running incumbent means only chunks that beat
+    # everything BEFORE them count as successes.
+    y = [0.9] * 8 + [0.8] * 8 + [0.7] * 8  # three successive improvements
+    length, succ, fail = tr_update_batch(0.8, 0, 0, 1.0, y, chunk=8,
+                                         improve_tol=1e-3, **kw)
+    assert (length, succ, fail) == (1.6, 0, 0)  # succ_tol=3 -> doubled
+
+
 def test_turbo_state_roundtrip_preserves_trust_region():
     from orion_tpu.algo.base import create_algo
     from orion_tpu.space.dsl import build_space
