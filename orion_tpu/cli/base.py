@@ -225,6 +225,12 @@ def build_from_args(args, need_user_args=True, allow_create=True, view=False):
         state = experiment.metadata.get("parser_state")
         if state and (state.get("template") or state.get("priors")):
             parser = CommandLineParser.from_state(state)
+        elif experiment.metadata.get("user_args"):
+            # Reference-Oríon experiments (db load migration) store the raw
+            # command instead of parser state — same prior DSL, so reparse
+            # it (reference metadata schema: experiment.py:120-155).
+            parser = CommandLineParser()
+            parser.parse(list(experiment.metadata["user_args"]))
         elif need_user_args:
             raise NoConfigurationError(
                 f"experiment {experiment.name!r} has no stored command to resume; "

@@ -330,6 +330,74 @@ def _iter_dump_docs(path, default_collection):
                 )
 
 
+def _depickle_values(value):
+    """Normalize pickled reference values to this framework's document
+    conventions: naive-UTC datetimes (the reference stamps
+    ``datetime.utcnow()``) -> epoch-second floats, tuples -> lists."""
+    import datetime
+
+    if isinstance(value, datetime.datetime):
+        if value.tzinfo is None:
+            value = value.replace(tzinfo=datetime.timezone.utc)
+        return value.timestamp()
+    if isinstance(value, dict):
+        return {k: _depickle_values(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_depickle_values(v) for v in value]
+    return value
+
+
+def _iter_reference_pickle_docs(path):
+    """Yield (collection, doc) from a reference-Oríon ``PickledDB`` file
+    (a pickled EphemeralDB — `reference pickleddb.py:162-174`).
+
+    Unpickling needs the reference's classes, i.e. ``import orion`` must
+    work — true for a real migrating user, who has Oríon installed next to
+    this framework.  Run ``db upgrade`` on the destination afterwards to
+    convert the reference's trial schema (params as [{name,type,value}])
+    to this framework's params dict."""
+    import pickle
+    import sys
+
+    from orion_tpu.storage.documents import MemoryDB
+    from orion_tpu.utils.exceptions import CheckError
+
+    try:
+        import orion.core.io.database.ephemeraldb  # noqa: F401
+    except ImportError as exc:
+        raise CheckError(
+            "this file is a pickled database; reading a reference-Oríon "
+            "PickledDB requires the `orion` package importable (run the "
+            "load where Oríon is installed, or export the data with "
+            "mongoexport / its own tooling and load the JSON instead)"
+        ) from exc
+    with open(path, "rb") as handle:
+        database = pickle.load(handle)
+    if isinstance(database, MemoryDB):
+        raise CheckError(
+            "this is an orion-tpu pickled database, not a reference-Oríon "
+            "one — use `db copy` to merge it"
+        )
+    collections = getattr(database, "_db", None)
+    if collections is None:
+        raise CheckError(
+            "not a reference Oríon pickled database (no collections inside)"
+        )
+    for name, collection in collections.items():
+        docs = collection.find()
+        if not docs:
+            continue
+        if name not in _COPY_COLLECTIONS:
+            print(
+                f"skipping reference collection {name!r} "
+                f"({len(docs)} document(s); no counterpart here)",
+                file=sys.stderr,
+            )
+            continue
+        for doc in docs:
+            yield name, _depickle_values(dict(doc))
+
+
 def _strip_id(doc):
     return {k: v for k, v in doc.items() if k != "_id"}
 
@@ -442,7 +510,15 @@ def main_load(args):
     dst = create_storage(_copy_spec_to_config(args.dst))
     by_collection = {}
     try:
-        for collection, doc in _iter_dump_docs(args.src, args.collection):
+        with open(args.src, "rb") as handle:
+            is_pickle = handle.read(1) == b"\x80"  # pickle protocol-2+ magic
+        if is_pickle:
+            # A reference-Oríon PickledDB artifact (migration path; follow
+            # with `db upgrade` on the destination to convert its schemas).
+            docs_iter = _iter_reference_pickle_docs(args.src)
+        else:
+            docs_iter = _iter_dump_docs(args.src, args.collection)
+        for collection, doc in docs_iter:
             if collection not in _COPY_COLLECTIONS:
                 raise CheckError(f"unknown collection {collection!r} in dump")
             by_collection.setdefault(collection, []).append(doc)
@@ -644,6 +720,12 @@ def main_upgrade(args):
             updates["priors"] = (doc.get("metadata") or {}).get("priors", {})
         if "refers" not in doc:
             updates["refers"] = {}
+        if "strategy" not in doc:
+            # Reference schema nests it (`producer.strategy`,
+            # reference experiment.py:120 / configuration dict).
+            strategy = (doc.get("producer") or {}).get("strategy")
+            if isinstance(strategy, str):
+                updates["strategy"] = strategy
         if updates:
             storage.update_experiment(uid=doc["_id"], **updates)
             migrated += 1
@@ -651,5 +733,19 @@ def main_upgrade(args):
     n_trials = storage.db.write(
         "trials", {"parents": []}, query={"parents": None}
     )
+    # Reference-schema trials: params is [{name, type, value}, ...]
+    # (reference `core/worker/trial.py` Param list) — convert to this
+    # framework's params dict keyed by name, batched so a file-backed
+    # destination pays one lock/rewrite cycle, not one per trial.
+    pairs = [
+        (
+            {"_id": doc["_id"]},
+            {"params": {p["name"]: p["value"] for p in doc["params"]}},
+        )
+        for doc in storage.db.read("trials")
+        if isinstance(doc.get("params"), list)
+    ]
+    if pairs:
+        n_trials += storage.db.update_many("trials", pairs)
     print(f"Upgraded {migrated} experiments, {n_trials} trials.")
     return 0

@@ -122,6 +122,10 @@ class PickledDB:
         with self._locked() as db:
             return db.write(collection, data, query)
 
+    def update_many(self, collection, pairs):
+        with self._locked() as db:
+            return db.update_many(collection, pairs)
+
     def read(self, collection, query=None, projection=None):
         with self._locked(write=False) as db:
             return db.read(collection, query, projection)

@@ -508,6 +508,17 @@ class MemoryDB:
                 return col.insert(data)
             return col.update(query, data, many=True)
 
+    def update_many(self, collection, pairs):
+        """Apply ``[(query, update), ...]`` in order; returns the total
+        matched count.  One lock here, one lock/load/dump cycle on the
+        pickled wrapper, one transaction on SQL, one pipelined round trip
+        on the network driver — the batched-update path schema migrations
+        (`db upgrade`) use instead of a write (and a full file rewrite on
+        file-backed stores) per document."""
+        with self._lock:
+            col = self._col(collection)
+            return sum(col.update(q, u, many=True) for q, u in pairs)
+
     def read(self, collection, query=None, projection=None):
         with self._lock:
             return self._col(collection).find(query, projection)

@@ -578,6 +578,20 @@ class NetworkDB:
     def write(self, collection, data, query=None):
         return self._call("write", collection, data, query=query)
 
+    def update_many(self, collection, pairs):
+        """One pipelined round trip (see MemoryDB.update_many); the first
+        per-op failure is raised after the whole batch has been drained."""
+        results = self.pipeline(
+            [("write", [collection, data], {"query": query})
+             for query, data in pairs]
+        )
+        total = 0
+        for result in results:
+            if isinstance(result, Exception):
+                raise result
+            total += result
+        return total
+
     def read(self, collection, query=None, projection=None):
         return self._call("read", collection, query=query, projection=projection)
 

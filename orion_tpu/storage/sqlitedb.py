@@ -364,6 +364,22 @@ class SQLiteDB:
             return count
 
     @_translate_errors
+    def update_many(self, collection, pairs):
+        """All updates in ONE transaction (see MemoryDB.update_many)."""
+        total = 0
+        with self._txn() as conn:
+            for query, data in pairs:
+                data = json.loads(_dumps(data))
+                for doc in self._scan(conn, collection, query):
+                    if not _matches(doc, query):
+                        continue
+                    new_doc = apply_update(doc, data)
+                    new_doc["_id"] = doc["_id"]
+                    self._replace(conn, collection, doc, new_doc)
+                    total += 1
+        return total
+
+    @_translate_errors
     def read(self, collection, query=None, projection=None):
         conn = self._conn()
         return [

@@ -1,0 +1,90 @@
+"""Import shim for the reference Oríon package (read-only, /root/reference).
+
+The migration fixture (``reference_orion_db.pkl``) is PRODUCED BY the
+reference's own storage write path (its PickledDB + Trial.to_dict schema —
+see gen_reference_db.py), and unpickling it back requires the reference
+package importable — exactly like a real user migrating from Oríon, who has
+``orion`` installed next to this framework.
+
+The reference image copy has no installed distribution, so three
+packaging-level dependencies are stubbed before import — ONLY plumbing, no
+reference behavior is replaced:
+
+- ``appdirs``: config-directory lookup (reference vendors it when packaged).
+- ``pkg_resources``: entry-point discovery; its factories
+  (`core/utils/__init__.py:80-160`) otherwise find implementations through
+  the installed distribution's entry points, so `register_factories`
+  registers the same classes the reference's setup.py advertises.
+- ``pymongo``: imported unconditionally by its mongodb driver module; the
+  fixture never touches MongoDB.
+"""
+
+import sys
+import types
+
+REF_SRC = "/root/reference/src"
+
+
+def install_reference(ref_src=REF_SRC, appdir_base="/tmp/orion-ref-appdirs"):
+    """Make ``import orion`` resolve to the reference checkout."""
+    if ref_src not in sys.path:
+        sys.path.insert(0, ref_src)
+    if "appdirs" not in sys.modules:
+        appdirs = types.ModuleType("appdirs")
+
+        class AppDirs:
+            def __init__(self, *args, **kwargs):
+                pass
+
+            user_data_dir = appdir_base + "/data"
+            site_data_dir = appdir_base + "/site_data"
+            user_config_dir = appdir_base + "/config"
+            site_config_dir = appdir_base + "/site_config"
+
+        appdirs.AppDirs = AppDirs
+        sys.modules["appdirs"] = appdirs
+    if "pkg_resources" not in sys.modules:
+        pkg = types.ModuleType("pkg_resources")
+        pkg.iter_entry_points = lambda *a, **k: []
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def _raise(*args, **kwargs):
+            raise DistributionNotFound()
+
+        pkg.DistributionNotFound = DistributionNotFound
+        pkg.get_distribution = _raise
+        sys.modules["pkg_resources"] = pkg
+    if "pymongo" not in sys.modules:
+        pymongo = types.ModuleType("pymongo")
+        errors = types.ModuleType("pymongo.errors")
+        for name in (
+            "DuplicateKeyError",
+            "BulkWriteError",
+            "ConnectionFailure",
+            "OperationFailure",
+        ):
+            setattr(errors, name, type(name, (Exception,), {}))
+
+        class MongoClient:
+            PORT = 27017
+
+        pymongo.MongoClient = MongoClient
+        pymongo.errors = errors
+        sys.modules["pymongo"] = pymongo
+        sys.modules["pymongo.errors"] = errors
+
+
+def register_factories():
+    """Register the implementations the reference's setup.py entry points
+    advertise (``Storage`` -> Legacy, ``OptimizationAlgorithm`` -> Random)."""
+    import orion.algo.random as random_mod
+    import orion.storage.legacy as legacy_mod
+    from orion.algo.base import OptimizationAlgorithm
+    from orion.storage.base import Storage
+
+    Storage.types = [legacy_mod.Legacy]
+    Storage.typenames = ["legacy"]
+    OptimizationAlgorithm.types = [random_mod.Random]
+    OptimizationAlgorithm.typenames = ["random"]

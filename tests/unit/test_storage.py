@@ -68,6 +68,23 @@ def test_db_update_with_query():
     assert db.count("c", {"st": "old"}) == 2
 
 
+def test_update_many_contract(storage):
+    """Batched per-document updates (`db upgrade`'s migration path): every
+    backend applies the pairs in order, returns the total matched count,
+    and pays one lock/transaction/round-trip for the whole batch."""
+    db = storage.db
+    ids = db.write("c", [{"k": i, "v": "old"} for i in range(4)])
+    n = db.update_many(
+        "c",
+        [({"_id": ids[i]}, {"v": f"new{i}"}) for i in range(3)]
+        + [({"k": 99}, {"v": "none"})],  # no match: counts 0, not an error
+    )
+    assert n == 3
+    docs = {d["k"]: d["v"] for d in db.read("c")}
+    assert docs == {0: "new0", 1: "new1", 2: "new2", 3: "old"}
+    assert db.update_many("c", []) == 0
+
+
 def test_db_projection():
     db = MemoryDB()
     db.write("c", {"a": 1, "b": {"c": 2, "d": 3}})
