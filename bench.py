@@ -157,8 +157,20 @@ def run_anchor_regret(X0, y0):
     return float(y.min()) - GLOBAL_MIN, times
 
 
+def bench_device_decomposition():
+    """Device-vs-tunnel split of one fused suggest round at the headline
+    shape (two-chain-length subtraction; suggest_bench.py is the full
+    instrument and docs/performance.md the published table)."""
+    from orion_tpu.benchmarks.suggest_bench import device_seconds
+
+    # Shorter chain/reps than the full instrument: bench.py runs every
+    # round and only needs the order of magnitude next to the wall number.
+    return device_seconds("hartmann6-q1024", reps=5, k_hi=9) * 1e3
+
+
 def main():
     ours_sps = bench_throughput()
+    device_ms = bench_device_decomposition()
 
     rng = np.random.default_rng(SEED)
     X0 = rng.uniform(size=(N_INIT, 6)).astype(np.float32)
@@ -183,6 +195,11 @@ def main():
                 "vs_baseline": round(ours_sps / anchor_sps, 2),
                 "regret": round(ours_regret, 6),
                 "anchor_regret": round(anchor_regret, 6),
+                # Decomposition of one q=1024 round (docs/performance.md):
+                # wall = device compute + this image's host<->device tunnel
+                # round trip + host-side transform/decode.
+                "wall_ms_per_round": round(1e3 * Q / ours_sps, 2),
+                "device_ms_per_round": round(device_ms, 2),
             }
         )
     )

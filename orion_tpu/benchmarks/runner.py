@@ -257,7 +257,7 @@ def main(argv=None):
     import argparse
 
     parser = argparse.ArgumentParser(prog="orion_tpu.benchmarks.runner")
-    parser.add_argument("--op", choices=["gram"],
+    parser.add_argument("--op", choices=["gram", "suggest"],
                         help="run an op micro-benchmark instead of presets")
     parser.add_argument("--kind", default="matern52",
                         choices=["matern52", "rbf"])
@@ -277,6 +277,11 @@ def main(argv=None):
         # with preset names must not believe the presets silently ran.
         if args.presets:
             parser.error("--op and preset names are mutually exclusive")
+        if args.op == "suggest":
+            from orion_tpu.benchmarks.suggest_bench import run_suggest_bench
+
+            run_suggest_bench(reps=args.reps, kernel=args.kind)
+            return
         from orion_tpu.benchmarks.gram_bench import run_gram_bench
 
         run_gram_bench(kind=args.kind, reps=args.reps)
