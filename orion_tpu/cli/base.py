@@ -45,6 +45,13 @@ def add_experiment_args(parser, with_user_args=True):
         action="store_true",
         help="resolve branching conflicts interactively instead of automatically",
     )
+    group.add_argument(
+        "--branch-to",
+        default=None,
+        metavar="name",
+        help="on a branching event, give the child experiment this name "
+        "instead of a version bump under the same name",
+    )
     if with_user_args:
         import argparse
 
@@ -209,7 +216,10 @@ def build_from_args(args, need_user_args=True, allow_create=True, view=False):
         max_broken=config.get("max_broken"),
         algorithms=config.get("algorithms"),
         strategy=config.get("strategy"),
-        branch_config={"manual_resolution": getattr(args, "manual_resolution", False)},
+        branch_config={
+            "manual_resolution": getattr(args, "manual_resolution", False),
+            "branch_to": getattr(args, "branch_to", None),
+        },
     )
     # Worker-level knobs, not part of the experiment's stored identity
     # (reference keeps them in the global worker config, `core/__init__.py:93`):

@@ -29,6 +29,24 @@ def test_hunt_with_changed_prior_branches(tmp_path):
         assert -10 <= t.params["/x"] <= 10
 
 
+def test_branch_to_names_the_child(tmp_path):
+    """--branch-to gives the child a fresh name (v1) instead of a version
+    bump, with the same refers/adapter wiring."""
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    cli_main(["hunt", "-n", "orig", *db, "--max-trials", "3", "--worker-trials", "3",
+              BLACK_BOX, "-x~uniform(-50, 50)"])
+    rc = cli_main(["hunt", "-n", "orig", *db, "--branch-to", "forked",
+                   "--max-trials", "3", "--worker-trials", "3",
+                   BLACK_BOX, "-x~uniform(-10, 10)"])
+    assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    [parent] = storage.fetch_experiments({"name": "orig"})
+    [child] = storage.fetch_experiments({"name": "forked"})
+    assert child["version"] == 1
+    assert child["refers"]["parent_id"] == parent["_id"]
+    assert child["priors"] == {"/x": "uniform(-10, 10)"}
+
+
 def test_resume_same_config_does_not_branch(tmp_path):
     db = ["--storage-path", str(tmp_path / "db.pkl")]
     cli_main(["hunt", "-n", "same", *db, "--max-trials", "4", "--worker-trials", "2",

@@ -1,0 +1,69 @@
+"""The showcase scripts in examples/ run through REAL hunts (VERDICT r4 #8)
+so they cannot silently rot — the reference's runnable-demo discipline
+(`/root/reference/tests/functional/demo/test_demo.py:51-102`).
+"""
+
+import os
+
+from orion_tpu.cli import main as cli_main
+from orion_tpu.storage import create_storage
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+EXAMPLES = os.path.abspath(os.path.join(HERE, "..", "..", "examples"))
+
+
+def _completed(path, name):
+    storage = create_storage({"type": "pickled", "path": path})
+    [exp] = storage.fetch_experiments({"name": name})
+    return [
+        t for t in storage.fetch_trials(uid=exp["_id"]) if t.status == "completed"
+    ]
+
+
+def test_mnist_lenet_example_end_to_end(tmp_path):
+    """Mixed Real/Integer/Categorical space, real (synthetic-data) training
+    in the trial subprocess — BASELINE config #4's docs example."""
+    db = str(tmp_path / "db.pkl")
+    rc = cli_main(
+        ["hunt", "-n", "lenet-example", "--storage-path", db,
+         "--max-trials", "3", "--worker-trials", "3",
+         os.path.join(EXAMPLES, "mnist_lenet.py"),
+         "--lr~loguniform(1e-3, 1e-1)",
+         "--batch-size~uniform(64, 256, discrete=True)",
+         "--width~uniform(1, 2, discrete=True)",
+         "--act~choices(['relu', 'tanh'])"]
+    )
+    assert rc == 0
+    completed = _completed(db, "lenet-example")
+    assert len(completed) == 3
+    for trial in completed:
+        assert 0.0 <= trial.objective.value <= 1.0  # a validation error rate
+        assert trial.params["/act"] in ("relu", "tanh")
+        assert trial.params["/batch-size"] == int(trial.params["/batch-size"])
+
+
+def test_fidelity_sweep_example_end_to_end(tmp_path):
+    """Multi-fidelity ladder through ASHA: low-epoch evaluations dominate
+    and at least one configuration is promoted to a higher budget."""
+    db = str(tmp_path / "db.pkl")
+    config = tmp_path / "conf.yaml"
+    config.write_text("algorithms: {asha: {num_brackets: 2}}\n")
+    rc = cli_main(
+        ["hunt", "-n", "fid-example", "-c", str(config), "--storage-path", db,
+         "--max-trials", "16", "--worker-trials", "16",
+         os.path.join(EXAMPLES, "fidelity_sweep.py"),
+         "--lr~loguniform(1e-4, 1e-1)",
+         "--width~uniform(16, 256, discrete=True)",
+         "--epochs~fidelity(1, 9, 3)"]
+    )
+    assert rc == 0
+    completed = _completed(db, "fid-example")
+    assert len(completed) >= 4
+    epochs = sorted({t.params["/epochs"] for t in completed})
+    assert set(epochs).issubset({1, 3, 9}) and len(epochs) >= 2
+    by_point = {}
+    for t in completed:
+        by_point.setdefault((t.params["/lr"], t.params["/width"]), []).append(
+            t.params["/epochs"]
+        )
+    assert any(len(v) > 1 for v in by_point.values())  # a real promotion
