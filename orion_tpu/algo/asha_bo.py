@@ -191,7 +191,9 @@ class ASHABO(ASHA):
         if self.trust_region and self._mf_y.shape[0] - len(yvals) >= self.n_init:
             # Cadence decoupled from batch size: big rounds are split into
             # tr_update_every-sized sub-rounds (tr_update_batch docstring).
-            self._tr_length, self._tr_succ, self._tr_fail = tr_update_batch(
+            # (the restart count is unused here: asha_bo's box rides the
+            # fidelity context and re-centers through rung promotion)
+            self._tr_length, self._tr_succ, self._tr_fail, _ = tr_update_batch(
                 self._tr_length, self._tr_succ, self._tr_fail,
                 prev_best, y, chunk=self.tr_update_every,
                 succ_tol=self.tr_succ_tol, fail_tol=self.tr_fail_tol,

@@ -40,6 +40,7 @@ class BOHB(Hyperband):
         gamma=0.25,
         n_candidates=1024,
         min_points=None,
+        bw_factor=1.0,
         n_devices=None,
         use_mesh=False,
     ):
@@ -50,8 +51,10 @@ class BOHB(Hyperband):
         self.gamma = float(gamma)
         self.n_candidates = int(n_candidates)
         self.min_points = int(min_points) if min_points is not None else d + 2
+        self.bw_factor = float(bw_factor)
         self._params.update(
-            gamma=self.gamma, n_candidates=self.n_candidates, min_points=self.min_points
+            gamma=self.gamma, n_candidates=self.n_candidates,
+            min_points=self.min_points, bw_factor=self.bw_factor,
         )
         # Candidate-axis SPMD for the KDE-ratio matmuls (same mesh semantics
         # as tpu_bo/asha_bo; BASELINE config #5's q=4096 scaling story).
@@ -107,6 +110,7 @@ class BOHB(Hyperband):
         if tier is None:
             return super()._new_cube(num)
         good, bad = good_bad_split(self._tier_x[tier], self._tier_y[tier], self.gamma)
+        good = self._boost_top_rungs(tier, good)
         return np.asarray(
             _tpe_suggest(
                 self.next_key(),
@@ -115,8 +119,31 @@ class BOHB(Hyperband):
                 self.n_candidates,
                 int(num),
                 mesh=self._mesh,
+                bw_factor=self.bw_factor,
             )
         )
+
+    def _boost_top_rungs(self, tier, good):
+        """Prepend the good splits of every budget ABOVE the model tier.
+
+        The model tier is the highest with >= min_points, so higher tiers
+        are exactly the promoted survivors — too few to model alone, but
+        the most trustworthy evidence there is.  Prepending them best-first
+        (highest budget first) puts them at the TOP of the rank-weighted
+        good set, so the KDE concentrates on full-budget evidence instead
+        of ignoring it (VERDICT r4 #5: classic single-tier BOHB wasted
+        every observation above the model tier).  A config promoted through
+        several budgets appears once per tier — the duplicate rows upweight
+        survivors, which is the point."""
+        boost = []
+        for upper in sorted((t for t in self._tier_y if t > tier), reverse=True):
+            ys = self._tier_y[upper]
+            n_good = max(1, int(np.ceil(self.gamma * ys.shape[0])))
+            order = np.argsort(ys, kind="stable")[:n_good]
+            boost.append(self._tier_x[upper][order])
+        if not boost:
+            return good
+        return np.concatenate(boost + [good])
 
     # --- state --------------------------------------------------------------
     def state_dict(self):

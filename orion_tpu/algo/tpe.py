@@ -23,13 +23,15 @@ from orion_tpu.parallel import device_mesh
 @algo_registry.register("tpe")
 class TPE(BaseAlgorithm):
     def __init__(self, space, seed=None, n_init=20, gamma=0.25, n_candidates=1024,
-                 n_devices=None, use_mesh=False):
+                 bw_factor=1.0, n_devices=None, use_mesh=False):
         super().__init__(
-            space, seed=seed, n_init=n_init, gamma=gamma, n_candidates=n_candidates
+            space, seed=seed, n_init=n_init, gamma=gamma,
+            n_candidates=n_candidates, bw_factor=bw_factor
         )
         self.n_init = n_init
         self.gamma = gamma
         self.n_candidates = n_candidates
+        self.bw_factor = float(bw_factor)
         self.use_mesh = use_mesh
         self._mesh = device_mesh(n_devices) if use_mesh else None
         self._x = np.zeros((0, space.n_cols), dtype=np.float32)
@@ -57,6 +59,7 @@ class TPE(BaseAlgorithm):
             self.n_candidates,
             num,
             mesh=self._mesh,
+            bw_factor=self.bw_factor,
         )
 
     def state_dict(self):
@@ -137,8 +140,8 @@ def _log_kde_product(x, points, bandwidth, log_w=None):
     return total
 
 
-@partial(jax.jit, static_argnames=("n_candidates", "num", "mesh"))
-def _tpe_suggest(key, good, bad, n_candidates, num, mesh=None):
+@partial(jax.jit, static_argnames=("n_candidates", "num", "mesh", "bw_factor"))
+def _tpe_suggest(key, good, bad, n_candidates, num, mesh=None, bw_factor=1.0):
     # top_k needs k <= pool size: q-batch requests can exceed the configured
     # candidate pool (q=4096 presets), so grow the pool to fit.
     n_candidates = max(n_candidates, num)
@@ -149,7 +152,10 @@ def _tpe_suggest(key, good, bad, n_candidates, num, mesh=None):
         n_candidates = -(-n_candidates // n_shards) * n_shards
     k_pick, k_noise, k_mix = jax.random.split(key, 3)
     m, d = n_candidates, good.shape[1]
-    bw_good = _bandwidth_1d(good)
+    # bw_factor < 1 sharpens the good-set KDE below the 1-D Scott rate —
+    # an exploitation knob for high-D spaces where even univariate
+    # bandwidths stay wide at realistic n.
+    bw_good = _bandwidth_1d(good) * bw_factor
     # Candidates ~ the product KDE: each DIMENSION independently picks a
     # good point and jitters by that dimension's 1-D bandwidth.  Per-dim
     # independence both matches the density being scored and recombines

@@ -60,7 +60,8 @@ def tr_update(length, succ, fail, improved, *, succ_tol, fail_tol,
     """One trust-region bookkeeping step (TuRBO schedule), shared by every
     algorithm hosting a box: expand after ``succ_tol`` consecutive improving
     rounds, halve after ``fail_tol`` stagnating ones, restart wide on
-    collapse (history is kept — only the box resets)."""
+    collapse (history is kept — only the box resets).  Returns
+    ``(length, succ, fail, restarted)``."""
     if improved:
         succ, fail = succ + 1, 0
     else:
@@ -69,9 +70,10 @@ def tr_update(length, succ, fail, improved, *, succ_tol, fail_tol,
         length, succ = min(2.0 * length, length_max), 0
     elif fail >= fail_tol:
         length, fail = length / 2.0, 0
-    if length < length_min:
+    restarted = length < length_min
+    if restarted:
         length, succ, fail = length_init, 0, 0
-    return length, succ, fail
+    return length, succ, fail, restarted
 
 
 def tr_update_batch(length, succ, fail, prev_best, objectives, *, chunk,
@@ -92,16 +94,18 @@ def tr_update_batch(length, succ, fail, prev_best, objectives, *, chunk,
     behavior."""
     y = np.asarray(objectives, dtype=np.float64).ravel()
     best = float(prev_best)
+    n_restarts = 0
     for i in range(0, y.shape[0], chunk):
         chunk_best = float(np.min(y[i : i + chunk]))
         improved = chunk_best < best - improve_tol * abs(best)
-        length, succ, fail = tr_update(
+        length, succ, fail, restarted = tr_update(
             length, succ, fail, improved,
             succ_tol=succ_tol, fail_tol=fail_tol, length_init=length_init,
             length_min=length_min, length_max=length_max,
         )
+        n_restarts += restarted
         best = min(best, chunk_best)
-    return length, succ, fail
+    return length, succ, fail, n_restarts
 
 
 @algo_registry.register("tpu_bo")
@@ -245,6 +249,9 @@ class TPUBO(BaseAlgorithm):
         self._tr_length = tr_length_init
         self._tr_succ = 0
         self._tr_fail = 0
+        # Fresh-restart override: row index the trust box centers on after a
+        # collapse with no progress (None = the global incumbent).
+        self._tr_center = None
 
     # Naive-copy sharing (base __deepcopy__): the mesh handle is not
     # copyable and the fitted GP state / observation buffers are
@@ -266,7 +273,8 @@ class TPUBO(BaseAlgorithm):
             # Decoupled from batch size: a big observe round is split into
             # tr_update_every-sized sub-rounds (see tr_update_batch) so the
             # box gets the same adaptation count a small-batch run would.
-            self._tr_length, self._tr_succ, self._tr_fail = tr_update_batch(
+            (self._tr_length, self._tr_succ, self._tr_fail,
+             n_restarts) = tr_update_batch(
                 self._tr_length, self._tr_succ, self._tr_fail,
                 prev_best, objectives, chunk=self.tr_update_every,
                 succ_tol=self.tr_succ_tol, fail_tol=self.tr_fail_tol,
@@ -275,6 +283,30 @@ class TPUBO(BaseAlgorithm):
                 length_max=self.tr_length_max,
                 improve_tol=self.tr_improve_tol,
             )
+            new_best = float(np.min(self._y))
+            if new_best < prev_best - self.tr_improve_tol * abs(prev_best):
+                # Progress: the box belongs back on the true incumbent.
+                self._tr_center = None
+            elif n_restarts:
+                # Collapse without progress: re-centering the fresh box on
+                # the SAME stuck incumbent replays the failed search (the
+                # round-4 tail diagnosis — the worst seed's box cycled
+                # 0.4 -> 0.0125 -> restart four times around one point).
+                # Restart around the best observation that is at least an
+                # average-distance/4 away instead.
+                self._tr_center = self._fresh_restart_center()
+
+    def _fresh_restart_center(self):
+        """Index of the best observation usefully FAR from the incumbent
+        (>= a quarter of the mean distance to it); None when nothing
+        qualifies (early runs whose points all cluster)."""
+        best_idx = int(np.argmin(self._y))
+        d = np.sqrt(((self._x - self._x[best_idx]) ** 2).sum(axis=1))
+        far = d >= max(float(d.mean()) / 4.0, 1e-6)
+        if not far.any():
+            return None
+        candidates = np.where(far)[0]
+        return int(candidates[np.argmin(self._y[candidates])])
 
     # --- suggestion ---------------------------------------------------------
     def _suggest_cube(self, num):
@@ -287,7 +319,12 @@ class TPUBO(BaseAlgorithm):
         # dominates (each host->device round trip costs ~ms).  With a mesh,
         # the same compiled step shards the candidate axis over it (SPMD
         # collectives inserted by XLA, see orion_tpu.parallel).
-        best_x = self._x[int(np.argmin(self._y))]
+        center_idx = (
+            self._tr_center
+            if self._tr_center is not None and self._tr_center < n
+            else int(np.argmin(self._y))
+        )
+        best_x = self._x[center_idx]
         x_fit, y_raw = self._x, self._y
         if self.trust_region and self._x.shape[0] > self.tr_local_m:
             # LOCAL GP (the TuRBO design): fit only the tr_local_m nearest
@@ -327,6 +364,7 @@ class TPUBO(BaseAlgorithm):
         out["x"] = self._x.tolist()
         out["y"] = self._y.tolist()
         out["tr"] = [self._tr_length, self._tr_succ, self._tr_fail]
+        out["tr_center"] = self._tr_center
         return out
 
     def set_state(self, state):
@@ -338,6 +376,8 @@ class TPUBO(BaseAlgorithm):
         tr = state.get("tr")
         if tr is not None:
             self._tr_length, self._tr_succ, self._tr_fail = tr[0], int(tr[1]), int(tr[2])
+        center = state.get("tr_center")
+        self._tr_center = int(center) if center is not None else None
 
 
 @algo_registry.register("turbo")

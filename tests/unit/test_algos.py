@@ -230,18 +230,53 @@ def test_tr_update_batch_decouples_cadence_from_batch_size():
     batched = tr_update_batch(0.8, 0, 0, 1.0, [2.0] * 8, chunk=8,
                               improve_tol=1e-3, **kw)
     single = tr_update(0.8, 0, 0, False, **kw)
-    assert batched == single
+    assert batched == (*single[:3], single[3] + 0)  # + restart count
     # A stagnant 64-point round at chunk=8 is 8 failing sub-rounds:
     # fail_tol=2 halves the box 4 times (0.8 -> 0.05).
-    length, succ, fail = tr_update_batch(0.8, 0, 0, 1.0, [2.0] * 64, chunk=8,
-                                         improve_tol=1e-3, **kw)
+    length, succ, fail, n_restarts = tr_update_batch(
+        0.8, 0, 0, 1.0, [2.0] * 64, chunk=8, improve_tol=1e-3, **kw)
     assert length == 0.8 / 16
+    assert n_restarts == 0
     # An improving run: the running incumbent means only chunks that beat
     # everything BEFORE them count as successes.
     y = [0.9] * 8 + [0.8] * 8 + [0.7] * 8  # three successive improvements
-    length, succ, fail = tr_update_batch(0.8, 0, 0, 1.0, y, chunk=8,
-                                         improve_tol=1e-3, **kw)
+    length, succ, fail, n_restarts = tr_update_batch(
+        0.8, 0, 0, 1.0, y, chunk=8, improve_tol=1e-3, **kw)
     assert (length, succ, fail) == (1.6, 0, 0)  # succ_tol=3 -> doubled
+
+
+def test_fresh_restart_recenters_off_the_stuck_incumbent():
+    """A box collapse with NO progress moves the trust-box center to the
+    best observation far from the incumbent (r4 tail diagnosis: the worst
+    turbo seed re-collapsed around one point four times); any material
+    improvement snaps the center back to the true incumbent."""
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.space.dsl import build_space
+
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(
+        space,
+        {"tpu_bo": {"n_init": 2, "fit_steps": 2, "n_candidates": 64,
+                     "trust_region": True, "tr_fail_tol": 1,
+                     "tr_length_init": 0.6, "tr_length_min": 0.5}},
+        seed=0,
+    )
+    algo.observe(
+        [{"a": 0.1, "b": 0.1}, {"a": 0.9, "b": 0.9}, {"a": 0.5, "b": 0.1}],
+        [{"objective": 1.0}, {"objective": 2.0}, {"objective": 3.0}],
+    )
+    # One stagnant round: fail_tol=1 halves 0.6 -> 0.3 < min 0.5 -> restart.
+    algo.observe([{"a": 0.11, "b": 0.1}], [{"objective": 5.0}])
+    assert algo._tr_center == 1  # best point far from the stuck incumbent
+    # The center override must survive a state round trip.
+    clone = create_algo(
+        space, {"tpu_bo": {"n_init": 2, "trust_region": True}}, seed=0
+    )
+    clone.set_state(algo.state_dict())
+    assert clone._tr_center == 1
+    # Material improvement clears the override.
+    algo.observe([{"a": 0.2, "b": 0.2}], [{"objective": 0.1}])
+    assert algo._tr_center is None
 
 
 def test_turbo_state_roundtrip_preserves_trust_region():
@@ -414,6 +449,37 @@ def test_bohb_models_highest_informative_tier():
     if batch is not None:
         xs = np.asarray([p["x"] for p in batch])
         assert np.mean(np.abs(xs - 0.3) < 0.25) >= 0.5
+
+
+def test_bohb_boosts_top_rung_survivors():
+    """Points observed at budgets above the model tier are prepended
+    best-first (highest budget first), so rank weights favor full-budget
+    evidence; with nothing above the model tier the good set is untouched."""
+    import numpy as np
+
+    space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 9, 3)"})
+    algo = create_algo(space, {"bohb": {"min_points": 3}}, seed=0)
+    d = space.n_cols
+    algo._tier_x = {
+        1: np.arange(8 * d, dtype=np.float32).reshape(8, d) / 100.0,
+        3: np.full((2, d), 0.5, dtype=np.float32),
+        9: np.full((1, d), 0.9, dtype=np.float32),
+    }
+    algo._tier_y = {
+        1: np.arange(8, dtype=np.float32),
+        3: np.asarray([2.0, 1.0], dtype=np.float32),
+        9: np.asarray([0.5], dtype=np.float32),
+    }
+    assert algo._model_tier() == 1
+    good = np.zeros((2, d), dtype=np.float32)
+    boosted = algo._boost_top_rungs(1, good)
+    # gamma=0.25: ceil(0.25*2)=1 row from tier 3, 1 from tier 9, tier-9 first.
+    assert boosted.shape == (4, d)
+    assert np.allclose(boosted[0], 0.9)
+    assert np.allclose(boosted[1], 0.5)
+    assert np.allclose(boosted[2:], good)
+    # Highest tier as model tier: nothing above, good set unchanged.
+    assert algo._boost_top_rungs(9, good) is good
 
 
 def test_bohb_state_roundtrip():
