@@ -110,11 +110,11 @@ PRESETS = {
     # Trust-region GP-BO (TuRBO-style + elite-covariance/directional
     # candidates + posterior-mean polish) on the same 20-D valley and trial
     # budget as thompson-rosenbrock20/cmaes-rosenbrock20.  Small batches on
-    # purpose: the trust region adapts ONCE PER OBSERVE ROUND, and rounds
-    # of success/failure signal are what walk the box down the valley —
-    # measured on the chip, batch 8 (128 rounds) more than halves batch
-    # 16's median (5 seeds: 47.5 [24.5-452.5] vs 258 [82-866]), pulling
-    # even with cmaes' 46; see BENCH_SEEDS.json.
+    # purpose: rounds of real success/failure signal are what walk the box
+    # down the valley — measured on the chip, batch 8 (128 rounds) more
+    # than halves batch 16's median (258 -> 47.5 over 5 seeds), and
+    # round 5's fresh-region restarts take the 15-seed median to 35.8
+    # [23.0-344] p90 212, ahead of cmaes' 43.6; see BENCH_SEEDS.json.
     "turbo-rosenbrock20": dict(
         priors=_uniform_priors(20), fn="rosenbrock20",
         algorithm={"turbo": {"n_init": 64, "n_candidates": 8192,
