@@ -363,16 +363,20 @@ def _iter_reference_pickle_docs(path):
     from orion_tpu.utils.exceptions import CheckError
 
     try:
-        import orion.core.io.database.ephemeraldb  # noqa: F401
+        # Unpickle FIRST: an orion-tpu pickle needs no reference package,
+        # and its misdiagnosis ("install Oríon") must not shadow the real
+        # answer ("use db copy").  A reference pickle without `orion`
+        # importable surfaces here as ModuleNotFoundError.
+        with open(path, "rb") as handle:
+            database = pickle.load(handle)
     except ImportError as exc:
         raise CheckError(
-            "this file is a pickled database; reading a reference-Oríon "
-            "PickledDB requires the `orion` package importable (run the "
-            "load where Oríon is installed, or export the data with "
-            "mongoexport / its own tooling and load the JSON instead)"
+            "this file is a pickled database whose classes are not "
+            f"importable ({exc}); reading a reference-Oríon PickledDB "
+            "requires the `orion` package (run the load where Oríon is "
+            "installed, or export the data with mongoexport / its own "
+            "tooling and load the JSON instead)"
         ) from exc
-    with open(path, "rb") as handle:
-        database = pickle.load(handle)
     if isinstance(database, MemoryDB):
         raise CheckError(
             "this is an orion-tpu pickled database, not a reference-Oríon "

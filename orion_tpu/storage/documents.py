@@ -514,7 +514,15 @@ class MemoryDB:
         pickled wrapper, one transaction on SQL, one pipelined round trip
         on the network driver — the batched-update path schema migrations
         (`db upgrade`) use instead of a write (and a full file rewrite on
-        file-backed stores) per document."""
+        file-backed stores) per document.
+
+        Mid-batch failure semantics are backend-dependent, so callers must
+        be idempotent-re-runnable (the migration updates are): memory keeps
+        the applied prefix, pickled and SQLite discard the whole batch
+        (the pickled wrapper only dumps its state after a clean run;
+        SQLite's transaction rolls back), and the network driver applies
+        every non-failing pair before raising the first failure (the
+        pipeline is fully drained)."""
         with self._lock:
             col = self._col(collection)
             return sum(col.update(q, u, many=True) for q, u in pairs)

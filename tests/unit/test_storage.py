@@ -71,7 +71,11 @@ def test_db_update_with_query():
 def test_update_many_contract(storage):
     """Batched per-document updates (`db upgrade`'s migration path): every
     backend applies the pairs in order, returns the total matched count,
-    and pays one lock/transaction/round-trip for the whole batch."""
+    and pays one lock/transaction/round-trip for the whole batch.
+    (Mid-batch FAILURE state is deliberately backend-dependent — memory
+    keeps the prefix, pickled/SQLite discard the batch, network drains
+    everything; see MemoryDB.update_many's docstring — callers re-run
+    idempotently.)"""
     db = storage.db
     ids = db.write("c", [{"k": i, "v": "old"} for i in range(4)])
     n = db.update_many(
