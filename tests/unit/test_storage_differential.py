@@ -63,6 +63,11 @@ def _apply(db, op, payload):
             query, update = payload
             n = db.write("c", update, query=query)
             return ("n", n)
+        if op == "update_many":
+            # Happy-path batches only: mid-batch FAILURE state is a
+            # documented backend divergence (MemoryDB.update_many), so the
+            # fuzzer generates updates that cannot violate the unique index.
+            return ("n", db.update_many("c", payload))
         if op == "read":
             docs = db.read("c", payload)
             return ("docs", sorted(dumps_canonical(d) for d in docs))
@@ -113,9 +118,15 @@ def test_backends_agree_on_random_programs(seed, tmp_path):
             r = rng.random()
             if r < 0.45:
                 program.append(("insert", _random_doc(rng, i)))
-            elif r < 0.6:
+            elif r < 0.56:
                 program.append(
                     ("update", (_random_query(rng), {"a": rng.randint(0, 5)}))
+                )
+            elif r < 0.6:
+                program.append(
+                    ("update_many",
+                     [(_random_query(rng), {"a": rng.randint(0, 5)})
+                      for _ in range(rng.randint(0, 3))])
                 )
             elif r < 0.66:
                 program.append(("read", _random_query(rng)))
@@ -155,7 +166,7 @@ def test_backends_agree_on_random_programs(seed, tmp_path):
                     f"seed {seed} step {step} {op}: {name} returned {got!r}, "
                     f"oracle {expected!r} (payload {payload!r})"
                 )
-            if op in ("insert", "update", "dotted", "raw", "remove"):
+            if op in ("insert", "update", "update_many", "dotted", "raw", "remove"):
                 want = _canonical_state(oracle)
                 for name, db in backends.items():
                     if name == "memory":
