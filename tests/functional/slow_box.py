@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Black box that blocks while a sentinel file exists.
+
+Used by the SIGKILL elastic-recovery test: worker A runs this with the
+sentinel present (trial hangs mid-execution, heartbeat alive), gets killed
+-9, and the test removes the sentinel so worker B's re-run of the same
+stored cmdline template returns instantly.
+"""
+
+import argparse
+import os
+import time
+
+from orion_tpu.client import report_results
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-x", type=float, required=True)
+    args = parser.parse_args()
+    sentinel = os.environ.get("ORION_TEST_SLOW_SENTINEL", "")
+    deadline = time.time() + 120.0  # orphan self-destruct, never hangs CI
+    while sentinel and os.path.exists(sentinel) and time.time() < deadline:
+        time.sleep(0.1)
+    report_results(
+        [{"name": "objective", "type": "objective", "value": (args.x - 1.0) ** 2}]
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -8,8 +8,10 @@ DB, and the env/results contract (asserted inside black_box.py).
 
 import multiprocessing
 import os
+import signal
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -19,6 +21,7 @@ from orion_tpu.storage import create_storage
 HERE = os.path.dirname(os.path.abspath(__file__))
 BLACK_BOX = os.path.join(HERE, "black_box.py")
 BROKEN_BOX = os.path.join(HERE, "broken_box.py")
+SLOW_BOX = os.path.join(HERE, "slow_box.py")
 
 
 def storage_args(tmp_path):
@@ -113,6 +116,68 @@ def test_two_workers_one_db(tmp_path):
     ]
     assert len(completed) >= 10
     assert len({t.id for t in completed}) == len(completed)
+
+
+def test_sigkill_worker_mid_trial_recovers_and_completes(tmp_path):
+    """Real node-death recovery, not a simulated one: a worker process group
+    is SIGKILLed while its trial is executing (every other heartbeat test in
+    the suite backdates the timestamp instead).  The reserved trial's
+    heartbeat must go stale, a later worker must sweep it back to reservable
+    on its reservation path (reference `experiment.py:217-232`), and the hunt
+    must still complete its full budget with nothing left stuck in
+    ``reserved``."""
+    db_path = str(tmp_path / "db.pkl")
+    sentinel = tmp_path / "slow.sentinel"
+    sentinel.write_text("")
+    env = dict(os.environ)
+    env["ORION_TEST_SLOW_SENTINEL"] = str(sentinel)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "orion_tpu.cli", "hunt", "-n", "lazarus",
+         "--storage-path", db_path, "--max-trials", "3", "--worker-trials", "3",
+         "--heartbeat", "3", SLOW_BOX, "-x~uniform(-50,50)"],
+        env=env, start_new_session=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    storage = create_storage({"type": "pickled", "path": db_path})
+    killed_id = None
+    try:
+        # Wait until the worker has actually reserved a trial and launched
+        # the (blocked-on-sentinel) user script.
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            exps = storage.fetch_experiments({"name": "lazarus"})
+            if exps:
+                reserved = [
+                    t for t in storage.fetch_trials(uid=exps[0]["_id"])
+                    if t.status == "reserved"
+                ]
+                if reserved:
+                    killed_id = reserved[0].id
+                    break
+            time.sleep(0.2)
+        assert killed_id is not None, "worker never reserved a trial"
+        # Node death: kill the whole process group (worker + user script).
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:  # pragma: no cover - cleanup on assert failure
+            os.killpg(proc.pid, signal.SIGKILL)
+    sentinel.unlink()  # worker B's re-runs of the template return instantly
+    time.sleep(3.5)  # let the dead worker's last heartbeat go stale
+    rc = cli_main(
+        ["hunt", "-n", "lazarus", "--storage-path", db_path,
+         "--max-trials", "3", "--worker-trials", "10", "--heartbeat", "3"]
+    )
+    assert rc == 0
+    (exp,) = storage.fetch_experiments({"name": "lazarus"})
+    trials = storage.fetch_trials(uid=exp["_id"])
+    completed = [t for t in trials if t.status == "completed"]
+    assert len(completed) == 3
+    by_id = {t.id: t for t in trials}
+    # The killed trial was recovered: swept off `reserved` (and typically
+    # re-reserved and completed by worker B).
+    assert by_id[killed_id].status != "reserved"
+    assert all(t.status != "reserved" for t in trials)
 
 
 def test_console_entrypoint_runs():
