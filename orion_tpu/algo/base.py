@@ -232,6 +232,7 @@ _BUILTIN_MODULES = (
     "asha_bo",
     "bohb",
     "cmaes",
+    "de",
     "hyperband",
     "grid_search",
     "tpe",

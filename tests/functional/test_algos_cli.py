@@ -54,6 +54,7 @@ _FLAT_ROSTER = {
     "grid_search": {"n_values": 6},
     "tpe": {"n_init": 4, "n_candidates": 128},
     "cmaes": {"popsize": 6},
+    "de": {"popsize": 6},
     "tpu_bo": {"n_init": 4, "n_candidates": 128, "fit_steps": 3},
     "turbo": {"n_init": 4, "n_candidates": 128, "fit_steps": 3},
 }

@@ -24,6 +24,7 @@ ALGOS = [
     {"turbo": {"n_init": 8, "n_candidates": 256, "fit_steps": 15}},
     {"grid_search": {"n_values": 8}},
     {"cmaes": {"popsize": 8}},
+    {"de": {"popsize": 8}},
 ]
 
 
@@ -523,3 +524,111 @@ def test_turbo_polish_splice_clamped_to_tiny_pool():
     algo.observe(params, [{"objective": quadratic(p)} for p in params])
     out = algo.suggest(512)
     assert len(out) == 512
+
+
+def test_de_converges_on_sphere():
+    space = build_space({f"x{i}": "uniform(0, 1)" for i in range(5)})
+    algo = create_algo(space, {"de": {"popsize": 24}}, seed=1)
+
+    def sphere(p):
+        return sum((v - 0.4) ** 2 for v in p.values())
+
+    best = np.inf
+    # 60 generations: crowding DE trades convergence speed for niche
+    # preservation, so it needs more rounds than CMA-ES' 25 above (the
+    # fixed seed lands ~1.6e-4; the bound carries ~10x margin).
+    for _ in range(60):
+        params = algo.suggest(24)
+        ys = [sphere(p) for p in params]
+        best = min(best, min(ys))
+        algo.observe(params, [{"objective": y} for y in ys])
+    assert best < 2e-3
+    # The population must have contracted toward the optimum.
+    assert float(algo._fit.mean()) < 0.05
+
+
+def test_de_crowding_replaces_nearest_only_if_better():
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 4}}, seed=0)
+    pop = np.array(
+        [[0.1, 0.1], [0.9, 0.9], [0.1, 0.9], [0.9, 0.1]], dtype=np.float32
+    )
+    algo._pop = pop.copy()
+    algo._fit = np.array([1.0, 2.0, 3.0, 4.0], dtype=np.float32)
+    algo._n_filled = 4
+    # Near member 1 and better: replaces member 1, nobody else.
+    algo.observe_arrays(np.array([[0.85, 0.9]]), np.array([1.5]))
+    assert np.allclose(algo._pop[1], [0.85, 0.9])
+    assert algo._fit[1] == 1.5
+    assert np.allclose(algo._pop[0], pop[0])
+    # Near member 0 but worse: rejected even though it beats members 2/3.
+    algo.observe_arrays(np.array([[0.12, 0.1]]), np.array([2.5]))
+    assert np.allclose(algo._pop[0], pop[0])
+    assert algo._fit[0] == 1.0
+
+
+def test_de_seeding_then_proposal_phase():
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 8}}, seed=0)
+    params = algo.suggest(5)
+    algo.observe(params, [{"objective": 0.5} for _ in params])
+    assert algo._n_filled == 5  # still seeding
+    params = algo.suggest(5)
+    algo.observe(params, [{"objective": 0.4} for _ in params])
+    assert algo._n_filled == 8  # full; surplus went through crowding
+
+
+def test_de_state_roundtrip_resumes_identically():
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    a = create_algo(space, {"de": {"popsize": 8}}, seed=5)
+    params = a.suggest(8)
+    a.observe(params, [{"objective": (p["a"] - 0.5) ** 2} for p in params])
+    state = a.state_dict()
+
+    b = create_algo(space, {"de": {"popsize": 8}}, seed=5)
+    b.set_state(state)
+    pa, pb = a.suggest(4), b.suggest(4)
+    assert [tuple(p.values()) for p in pa] == [tuple(p.values()) for p in pb]
+
+
+def test_de_mixed_space_and_lie_clamping():
+    space = build_space(
+        {
+            "lr": "loguniform(1e-4, 1e-1)",
+            "units": "uniform(16, 256, discrete=True)",
+            "act": "choices(['relu', 'tanh', 'gelu'])",
+        }
+    )
+    algo = create_algo(space, {"de": {"popsize": 8}}, seed=2)
+    params = algo.suggest(8)
+    for p in params:
+        assert 1e-4 <= p["lr"] <= 1e-1
+        assert isinstance(p["units"], int)
+        assert p["act"] in ("relu", "tanh", "gelu")
+    # Inf-sentinel lies are dropped instead of entering the population.
+    ys = [float(i) for i in range(7)] + [np.inf]
+    algo.observe(params, [{"objective": y} for y in ys])
+    assert algo.n_observed == 8
+    assert np.isfinite(algo._fit).all()
+
+
+def test_de_inf_lie_cannot_enter_population_with_fabricated_fitness():
+    """Adversarial lie scenario: a converged population receives a batch
+    with one genuinely good result AND an inf lie.  Clamping the inf to the
+    batch's best-ish finite value would let a never-evaluated point displace
+    a member with near-best fabricated fitness — it must be dropped."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 4}}, seed=0)
+    algo._pop = np.array(
+        [[0.1, 0.1], [0.9, 0.9], [0.1, 0.9], [0.9, 0.1]], dtype=np.float32
+    )
+    algo._fit = np.full((4,), 0.01, dtype=np.float32)
+    algo._n_filled = 4
+    # Row 0: real improvement near member 0.  Row 1: inf lie near member 1.
+    algo.observe_arrays(
+        np.array([[0.11, 0.1], [0.89, 0.9]]), np.array([0.001, np.inf])
+    )
+    assert algo._fit[0] == np.float32(0.001)  # real result accepted
+    assert np.allclose(algo._pop[1], [0.9, 0.9])  # lie did NOT displace
+    assert algo._fit[1] == np.float32(0.01)
+    assert np.isfinite(algo._fit).all()
