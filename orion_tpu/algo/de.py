@@ -75,6 +75,9 @@ class DifferentialEvolution(BaseAlgorithm):
     cr: binomial crossover rate; high values suit non-separable landscapes.
     mutation: ``"rand1"`` (default, robust) or ``"best1"`` (greedy —
         faster on unimodal landscapes, premature elsewhere).
+    tol_pop: declare ``is_done`` when every member sits within this
+        distance of the best (collapsed population: all difference vectors
+        are ~0, every future mutant repeats the incumbent).
     """
 
     supports_async_suggest = True
@@ -88,6 +91,7 @@ class DifferentialEvolution(BaseAlgorithm):
         f_hi=1.0,
         cr=0.9,
         mutation="rand1",
+        tol_pop=1e-6,
     ):
         d = space.n_cols
         if popsize is None:
@@ -97,13 +101,19 @@ class DifferentialEvolution(BaseAlgorithm):
             raise ValueError(f"mutation must be 'rand1' or 'best1', got {mutation!r}")
         super().__init__(
             space, seed=seed, popsize=popsize, f_lo=f_lo, f_hi=f_hi, cr=cr,
-            mutation=mutation,
+            mutation=mutation, tol_pop=tol_pop,
         )
         self.popsize = popsize
         self.f_lo = float(f_lo)
         self.f_hi = float(f_hi)
         self.cr = float(cr)
         self.mutation = mutation
+        # The population is float32 (ulp ~6e-8 at 0.5) and crowding demands
+        # strict improvement, so members freeze a few ulps apart once the
+        # objective plateaus — a tolerance below ~1e-6 could never fire;
+        # clamp instead of silently dead-ending is_done (cmaes' tol_sigma
+        # treatment).
+        self.tol_pop = max(float(tol_pop), 1e-6)
         self._pop = np.zeros((popsize, d), dtype=np.float32)
         self._fit = np.zeros((popsize,), dtype=np.float32)
         self._n_filled = 0
@@ -134,12 +144,18 @@ class DifferentialEvolution(BaseAlgorithm):
         # "assume bad" lie can never win a crowding competition, so dropping
         # it is semantics-preserving.
         cube = np.asarray(cube, dtype=np.float32)
-        objectives = np.asarray(objectives, dtype=np.float32)
+        # Filter on the INCOMING (float64) values — casting first would
+        # overflow large finite objectives (big-M penalties ~1e39) to inf
+        # and silently drop real evaluations; clip the survivors into
+        # float32 range instead.
+        objectives = np.asarray(objectives, dtype=np.float64)
         finite = np.isfinite(objectives)
         if not finite.all():
             cube, objectives = cube[finite], objectives[finite]
         if objectives.size == 0:
             return
+        f32_max = float(np.finfo(np.float32).max)
+        objectives = np.clip(objectives, -f32_max, f32_max).astype(np.float32)
         for row, y in zip(cube, objectives):
             if self._n_filled < self.popsize:
                 self._pop[self._n_filled] = row
@@ -153,6 +169,18 @@ class DifferentialEvolution(BaseAlgorithm):
             if y < self._fit[j]:
                 self._pop[j] = row
                 self._fit[j] = y
+
+    # --- lifecycle ----------------------------------------------------------
+    @property
+    def is_done(self):
+        """Population collapse: every member within ``tol_pop`` of the best
+        (all difference vectors ~0, so every future mutant is the incumbent
+        — the producer would otherwise grind on duplicate suggestions until
+        SampleTimeout, the exhausted-algorithm failure mode)."""
+        if self._n_filled < self.popsize:
+            return False
+        spread = np.abs(self._pop - self._pop[np.argmin(self._fit)][None, :]).max()
+        return float(spread) <= self.tol_pop
 
     # --- state --------------------------------------------------------------
     def state_dict(self):

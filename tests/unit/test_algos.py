@@ -632,3 +632,44 @@ def test_de_inf_lie_cannot_enter_population_with_fabricated_fitness():
     assert np.allclose(algo._pop[1], [0.9, 0.9])  # lie did NOT displace
     assert algo._fit[1] == np.float32(0.01)
     assert np.isfinite(algo._fit).all()
+
+
+def test_de_is_done_on_population_collapse():
+    """A collapsed population (all members identical) can only re-propose
+    the incumbent — is_done must fire instead of letting the producer grind
+    on duplicate suggestions until SampleTimeout."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 4}}, seed=0)
+    assert not algo.is_done  # still seeding
+    algo._pop = np.full((4, 2), 0.25, dtype=np.float32)
+    algo._fit = np.array([1.0, 1.0, 1.0, 1.0], dtype=np.float32)
+    algo._n_filled = 4
+    assert algo.is_done
+    algo._pop[0, 0] = 0.75  # any surviving spread: keep optimizing
+    assert not algo.is_done
+
+
+def test_de_is_done_fires_at_float32_resolution():
+    """Members frozen a few ulps apart (the real plateau end-state — crowding
+    demands strict improvement, so exact equality never happens) must still
+    count as collapsed: tol_pop is clamped to >= 1e-6."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 4, "tol_pop": 1e-12}}, seed=0)
+    assert algo.tol_pop >= 1e-6  # sub-resolution tolerance clamped
+    base = np.full((4, 2), 0.25, dtype=np.float32)
+    base[1, 0] = np.nextafter(np.float32(0.25), np.float32(1.0))  # one ulp off
+    algo._pop = base
+    algo._fit = np.full((4,), 1.0, dtype=np.float32)
+    algo._n_filled = 4
+    assert algo.is_done
+
+
+def test_de_large_finite_objectives_are_kept_not_dropped():
+    """A big-M penalty (finite in float64, inf after a float32 cast) is a
+    real evaluation: it must seed/compete, not vanish with the lie filter."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 4}}, seed=0)
+    params = algo.suggest(4)
+    algo.observe(params, [{"objective": 1e39} for _ in params])
+    assert algo._n_filled == 4  # seeding proceeded
+    assert np.isfinite(algo._fit).all()  # clipped into float32 range
