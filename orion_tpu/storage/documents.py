@@ -162,18 +162,27 @@ def _set_path(doc, dotted, value):
     node[parts[-1]] = value
 
 
+_SCALAR_TYPES = frozenset((str, int, float, bool, type(None)))
+
+
 def _copy_doc(value):
     """Deep copy for JSON-like documents (dict/list/scalars) without
     copy.deepcopy's dispatch+memo machinery — which dominated the in-memory
     backend's profile (28 s of a 32 s q=512 ackley50 run was deepcopy).
     Documents are acyclic JSON-ish trees, so direct recursion is safe;
-    exotic node values (numpy arrays, tuples, sets) fall back per-node."""
+    exotic node values (numpy arrays, tuples, sets) fall back per-node.
+    Scalar leaves are handled inline in the comprehensions — most nodes of
+    a trial document are {name,type,value} leaves, and a function call per
+    scalar is the bulk of the copy cost at q-batch scale."""
     tv = type(value)
     if tv is dict:
-        return {k: _copy_doc(v) for k, v in value.items()}
+        return {
+            k: (v if type(v) in _SCALAR_TYPES else _copy_doc(v))
+            for k, v in value.items()
+        }
     if tv is list:
-        return [_copy_doc(v) for v in value]
-    if tv is str or tv is int or tv is float or tv is bool or value is None:
+        return [v if type(v) in _SCALAR_TYPES else _copy_doc(v) for v in value]
+    if tv in _SCALAR_TYPES:
         return value
     return copy.deepcopy(value)
 
@@ -199,7 +208,17 @@ def _project(nested_doc, projection):
 
 
 def apply_update(doc, update):
-    """Return a copy of ``doc`` with a Mongo-style update applied.
+    """Return a new doc with a Mongo-style update applied; ``doc`` is never
+    mutated.
+
+    Copy-on-write along the updated paths only: the returned doc SHARES
+    every unmodified subtree with ``doc``.  That is safe because every
+    caller replaces the stored doc with the result and discards the old one
+    (reads hand out `_copy_doc`/`_project` copies, and indexes reference
+    `_id`s, not subtrees) — and it is what keeps a 2-field status update
+    from deep-copying a several-hundred-node trial document (a 2048-trial
+    ackley50 sweep spends ~35% of its host wall in `_copy_doc` otherwise,
+    most of it under updates).
 
     Walks dotted update keys into the nested doc directly — never
     flatten/unflatten the whole document, which would restructure any
@@ -208,23 +227,28 @@ def apply_update(doc, update):
     semantics cannot diverge."""
     sets = update.get("$set") if any(k.startswith("$") for k in update) else update
     unsets = update.get("$unset", {})
-    new_doc = _copy_doc(doc)
+    new_doc = dict(doc)
     for key, value in (sets or {}).items():
         parts = key.split(".")
         node = new_doc
         for part in parts[:-1]:
-            if not isinstance(node.get(part), dict):
-                node[part] = {}
+            child = node.get(part)
+            # Shallow-copy the dict on the path (COW); anything else is
+            # replaced by {} (previous behavior).  Re-copying a dict this
+            # update already copied is redundant but harmless.
+            node[part] = dict(child) if isinstance(child, dict) else {}
             node = node[part]
         node[parts[-1]] = _copy_doc(value)
     for key in unsets:
         parts = key.split(".")
         node = new_doc
         for part in parts[:-1]:
-            node = node.get(part)
-            if not isinstance(node, dict):
+            child = node.get(part)
+            if not isinstance(child, dict):
                 node = None
                 break
+            node[part] = dict(child)
+            node = node[part]
         if isinstance(node, dict):
             node.pop(parts[-1], None)
     return new_doc

@@ -927,3 +927,54 @@ def test_numpy_field_values_match_like_their_list_form():
     assert [d["_id"] for d in mem.read("c", {"a": {"$ne": 2}})] == [1]
     assert [d["_id"] for d in mem.read("c", {"a": {"$in": [2, 9]}})] == [2]
     assert mem.count("c", {"a": 2}) == 1
+
+
+def test_apply_update_cow_invariants():
+    """apply_update's contract: input doc NEVER mutated; result may share
+    unmodified subtrees but every path touched by the update is fresh.
+    These invariants are what make the copy-on-write rewrite safe — pin
+    them so a future edit cannot silently hand out mutable store state."""
+    import copy as _copy
+
+    from orion_tpu.storage.documents import apply_update
+
+    doc = {
+        "_id": 1,
+        "status": "new",
+        "params": [{"name": "/x", "type": "real", "value": 0.5}],
+        "meta": {"a": {"deep": 1}, "b": 2},
+    }
+    snapshot = _copy.deepcopy(doc)
+    new = apply_update(doc, {"$set": {"status": "reserved", "meta.a.deep": 9},
+                             "$unset": {"meta.b": 1}})
+    assert doc == snapshot  # input untouched, including the $unset path
+    assert new["status"] == "reserved"
+    assert new["meta"]["a"]["deep"] == 9 and "b" not in new["meta"]
+    # Touched path dicts are fresh objects (mutating them cannot reach doc).
+    assert new is not doc and new["meta"] is not doc["meta"]
+    assert new["meta"]["a"] is not doc["meta"]["a"]
+    # The $set VALUE is detached from the caller's payload.
+    payload = {"results": [{"name": "o", "type": "objective", "value": 1.0}]}
+    new2 = apply_update(doc, payload)
+    payload["results"][0]["value"] = 999.0
+    assert new2["results"][0]["value"] == 1.0
+
+
+def test_store_state_immune_to_caller_mutation():
+    """Mutating anything a read/CAS handed out must not change the store."""
+    from orion_tpu.storage.documents import MemoryDB
+
+    db = MemoryDB()
+    db.write("c", {"_id": 1, "status": "new",
+                   "params": [{"name": "/x", "value": 0.5}]})
+    # Mutate a find() result, deep and shallow.
+    (got,) = db.read("c", {"_id": 1})
+    got["status"] = "hacked"
+    got["params"][0]["value"] = -1.0
+    # Mutate a read_and_write() result (post-COW doc shares subtrees with
+    # the stored doc's predecessor, never with the stored doc itself).
+    ret = db.read_and_write("c", {"_id": 1}, {"status": "reserved"})
+    ret["params"][0]["value"] = -2.0
+    (fresh,) = db.read("c", {"_id": 1})
+    assert fresh["status"] == "reserved"
+    assert fresh["params"][0]["value"] == 0.5
