@@ -97,10 +97,28 @@ class Bracket:
             for r in self.rungs
         ]
 
+    def __deepcopy__(self, memo):
+        """Naive-copy support (producer lie fantasization): rung ENTRIES are
+        immutable-by-rebinding — `register`/`promote` always assign whole
+        `(objective, params)` tuples, never mutate one in place — so the
+        clone only needs fresh results DICTS (its inserts must not leak
+        back), sharing the entries.  A true deepcopy walked ~325k dict
+        nodes per produce round at 2048 trials (~0.25 s/round)."""
+        cls = type(self)
+        clone = cls.__new__(cls)
+        memo[id(self)] = clone
+        clone.reduction_factor = self.reduction_factor
+        clone.rungs = self.state()
+        return clone
+
 
 @algo_registry.register("asha")
 class ASHA(BaseAlgorithm):
     requires_fidelity = True
+
+    # str -> int with immutable values; the naive copy only needs its own
+    # dict so clone-side assignments don't leak back (base _share_dicts).
+    _share_dicts = ("_bracket_of",)
 
     def __init__(
         self,
@@ -140,9 +158,14 @@ class ASHA(BaseAlgorithm):
 
     # --- identity ------------------------------------------------------------
     def _point_hash(self, params):
-        """md5 over non-fidelity params (reference `asha.py:204-210`)."""
+        """md5 over non-fidelity params (reference `asha.py:204-210`).
+
+        One C-level ``repr`` of the sorted item tuples — a python-level
+        ``repr(v)`` per value was ~0.5 s of a 2048-trial ackley50 sweep
+        (51 dims x every observe/sample).  Dedup semantics are unchanged:
+        two params hash equal iff their sorted (name, value) reprs match."""
         items = sorted(
-            (k, repr(v)) for k, v in params.items() if k != self.fidelity_name
+            (k, v) for k, v in params.items() if k != self.fidelity_name
         )
         return hashlib.md5(repr(items).encode()).hexdigest()
 
