@@ -12,6 +12,8 @@ contract of the reference (numpy RandomState in state_dict) becomes a JAX
 PRNGKey threaded through state — seeding is explicit and resumable.
 """
 
+import functools
+
 import numpy as np
 import jax
 
@@ -19,6 +21,17 @@ from orion_tpu.space.space import Space
 from orion_tpu.utils.registry import Registry
 
 algo_registry = Registry("algo")
+
+
+@functools.lru_cache(maxsize=None)
+def _effective_share(cls):
+    """Union of ``_share_by_ref`` / ``_share_dicts`` over the MRO, so a
+    subclass's declaration extends rather than shadows its parents'."""
+    ref, dicts = set(), set()
+    for klass in cls.__mro__:
+        ref.update(klass.__dict__.get("_share_by_ref", ()))
+        dicts.update(klass.__dict__.get("_share_dicts", ()))
+    return frozenset(ref), frozenset(dicts)
 
 
 class BaseAlgorithm:
@@ -58,6 +71,10 @@ class BaseAlgorithm:
     # - _share_dicts: dicts WHOSE VALUES follow that discipline but which
     #   are themselves mutated by key assignment — shallow-copied so the
     #   clone's inserts don't leak back.
+    # The effective sets are the UNION over the class's MRO (see
+    # _effective_share): a subclass declaring its own tuple extends its
+    # parents' instead of silently shadowing them (bohb's tier dicts once
+    # hid ASHA's _bracket_of exactly that way).
     _share_by_ref = ("space",)
     _share_dicts = ()
 
@@ -65,12 +82,13 @@ class BaseAlgorithm:
         import copy as _copy
 
         cls = type(self)
+        share_ref, share_dicts = _effective_share(cls)
         clone = cls.__new__(cls)
         memo[id(self)] = clone
         for key, value in self.__dict__.items():
-            if key in self._share_by_ref:
+            if key in share_ref:
                 setattr(clone, key, value)
-            elif key in self._share_dicts:
+            elif key in share_dicts:
                 setattr(clone, key, dict(value))
             else:
                 setattr(clone, key, _copy.deepcopy(value, memo))

@@ -32,12 +32,20 @@ RESULT_TYPES = ("objective", "constraint", "gradient", "statistic", "lie")
 PARAM_TYPES = ("integer", "real", "categorical", "fidelity")
 
 
+_PLAIN_SCALARS = frozenset((str, int, float, bool, type(None)))
+
+
 def _canonical(value):
     """Print-independent canonical form of a param value for hashing.
 
     ``repr`` of numpy arrays is truncated by print options, so distinct large
     arrays would collide; normalize array-likes to full nested lists first.
+    Plain python scalars (the overwhelmingly common case — one call per param
+    per trial-id computation) shortcut straight to ``repr``, which is exactly
+    what the general path returns for them, so stored trial ids are unchanged.
     """
+    if type(value) in _PLAIN_SCALARS:
+        return repr(value)
     try:
         import numpy as np
 

@@ -673,3 +673,31 @@ def test_de_large_finite_objectives_are_kept_not_dropped():
     algo.observe(params, [{"objective": 1e39} for _ in params])
     assert algo._n_filled == 4  # seeding proceeded
     assert np.isfinite(algo._fit).all()  # clipped into float32 range
+
+
+def test_naive_copy_share_tuples_union_over_mro():
+    """A subclass's _share_by_ref/_share_dicts must EXTEND its parents'
+    declarations, not shadow them — bohb's tier dicts once hid ASHA's
+    _bracket_of exactly that way, re-enabling the full deepcopy the
+    sharing discipline exists to avoid."""
+    import copy as _copy
+
+    from orion_tpu.algo.base import _effective_share, _import_builtins, algo_registry
+
+    _import_builtins()
+    bohb_cls = algo_registry.get("bohb")
+    ref, dicts = _effective_share(bohb_cls)
+    assert {"_tier_x", "_tier_y", "_bracket_of"} <= dicts
+    assert {"space", "_mesh"} <= ref
+
+    # Behavioral check: the clone gets its own _bracket_of dict (inserts
+    # don't leak back) without a deep walk (identical key objects shared).
+    space = build_space({"x": "uniform(0, 1)", "epochs": "fidelity(1, 9, 3)"})
+    algo = create_algo(space, {"bohb": {"min_points": 4}}, seed=0)
+    params = algo.suggest(4)
+    algo.observe(params, [{"objective": float(i)} for i in range(4)])
+    clone = _copy.deepcopy(algo)
+    assert clone._bracket_of is not algo._bracket_of
+    assert clone._bracket_of == algo._bracket_of
+    clone._bracket_of["sentinel"] = 0
+    assert "sentinel" not in algo._bracket_of
