@@ -176,9 +176,8 @@ class TreeTrialsFetcher:
 
     def _fetch_node(self, exp_id, chain, direction):
         cache = self._cache.setdefault(exp_id, {"sig": {}, "adapted": {}})
-        sig_docs = self.storage.db.read(
-            "trials",
-            {"experiment": exp_id},
+        sig_docs = self.storage.read_trial_docs(
+            exp_id,
             projection={"status": 1, "end_time": 1, "submit_time": 1},
         )
         sigs = {
@@ -188,9 +187,7 @@ class TreeTrialsFetcher:
             tid for tid, sig in sigs.items() if cache["sig"].get(tid) != sig
         ]
         if changed:
-            docs = self.storage.db.read(
-                "trials", {"experiment": exp_id, "_id": {"$in": changed}}
-            )
+            docs = self.storage.read_trial_docs(exp_id, ids=changed)
             from orion_tpu.core.trial import Trial
 
             for doc in docs:

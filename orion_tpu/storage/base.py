@@ -298,6 +298,18 @@ class DocumentStorage(BaseStorage):
         docs.sort(key=_trial_doc_order)
         return [Trial.from_dict(d) for d in docs]
 
+    def read_trial_docs(self, uid, ids=None, projection=None):
+        """Raw trial documents for an experiment, optionally id-filtered and
+        projected.  The supported read path for consumers that need
+        signature-level reads without Trial construction — the EVC tree
+        fetch's incremental cache (`evc/experiment.py`) — and therefore a
+        whitelisted READ-ONLY operation; reaching for ``storage.db`` instead
+        breaks on `ExperimentView`'s read-only proxy."""
+        query = {"experiment": uid}
+        if ids is not None:
+            query["_id"] = {"$in": list(ids)}
+        return self._db.read("trials", query, projection=projection)
+
     def fetch_update_view(self, experiment, known_completed=-1):
         """The producer's per-round sync snapshot: ``(trials, n_completed)``.
 
@@ -516,6 +528,7 @@ _READONLY_METHODS = {
     "fetch_lost_trials",
     "fetch_noncompleted_trials",
     "get_trial",
+    "read_trial_docs",
     "count_completed_trials",
     "count_broken_trials",
     "fetch_timings",

@@ -514,3 +514,30 @@ def test_branching_prompt_per_command_completion():
     # Resolved conflicts drop out of the candidates.
     prompt.do_add("/y 2.5")
     assert prompt.complete_add("/", "add /", 4, 5) == []
+
+
+def test_readonly_view_fetches_evc_tree(storage):
+    """Regression: the EVC tree fetch must ride WHITELISTED read-only
+    storage ops (read_trial_docs), not storage.db — a dashboard holding an
+    ExperimentView over a branched experiment used to get AttributeError
+    from the read-only proxy on exactly the call with_evc_tree exists for."""
+    from orion_tpu.core.experiment import ExperimentView
+
+    e1 = build_experiment(
+        storage, "ro", priors={"/x": "uniform(0, 10)"}, algorithms="random"
+    ).instantiate()
+    run_trials(e1, [1.0, 2.0])
+    e2 = build_experiment(
+        storage, "ro", priors={"/x": "uniform(0, 5)"}, algorithms="random"
+    )
+    assert e2.version == 2
+
+    view = ExperimentView(e2)
+    tree_trials = view.fetch_trials(with_evc_tree=True)
+    in_range = [
+        t for t in storage.fetch_trials(uid=e1.id) if t.params["/x"] <= 5
+    ]
+    assert len(tree_trials) == len(in_range)
+    # The view stays read-only: raw db access is still refused.
+    with pytest.raises(AttributeError):
+        view.storage.db

@@ -42,10 +42,12 @@ def _random_query(rng):
     kind = rng.random()
     if kind < 0.4:
         return {field: rng.choice([0, 1, 2, "x", None])}
-    if kind < 0.6:
+    if kind < 0.55:
         return {field: {"$in": [rng.randint(0, 2), "x"]}}
-    if kind < 0.75:
+    if kind < 0.65:
         return {field: {"$gte": rng.randint(0, 2)}}
+    if kind < 0.72:
+        return {field: {rng.choice(["$gt", "$lt", "$lte"]): rng.randint(0, 2)}}
     if kind < 0.9:
         return {field: {"$ne": rng.randint(0, 2)}}
     return {}
@@ -136,11 +138,20 @@ def test_backends_agree_on_random_programs(seed, tmp_path):
                      (_random_query(rng),
                       rng.choice([{"a": 1}, {"b.c": 1}, {"a": 1, "_id": 0}])))
                 )
-            elif r < 0.78:
+            elif r < 0.75:
                 # Dotted-path update: creates/overwrites a nested leaf.
                 program.append(
                     ("dotted",
                      (_random_query(rng), {"b.c": rng.randint(10, 12)}))
+                )
+            elif r < 0.78:
+                # $set + $unset combo — the copy-on-write unset walk must
+                # agree across backends (incl. unsetting a missing path).
+                program.append(
+                    ("dotted",
+                     (_random_query(rng),
+                      {"$set": {"a": rng.randint(0, 5)},
+                       "$unset": {rng.choice(["b.c", "tags", "missing.x"]): 1}}))
                 )
             elif r < 0.84:
                 program.append(("count", _random_query(rng)))
