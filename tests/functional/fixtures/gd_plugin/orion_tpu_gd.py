@@ -1,0 +1,56 @@
+"""Third-party algorithm plugin fixture.
+
+Mirror of the reference's pip-installable plugin test package
+(`tests/functional/gradient_descent_algo/` — its ``Gradient_Descent``
+registers through an entry point and the functional suite proves the
+plugin system by converging it on the quadratic demo).  This package is
+NOT part of orion_tpu: it is installed by the plugin functional test into
+an isolated ``--target`` dir and discovered purely through its
+``orion_tpu.algo`` entry point.
+"""
+
+from orion_tpu.algo.base import BaseAlgorithm
+
+
+class GradientDescent(BaseAlgorithm):
+    """Toy steepest descent driven by the trial's reported ``gradient``
+    result (the quadratic demo box reports one next to its objective)."""
+
+    def __init__(self, space, seed=None, learning_rate=0.1):
+        super().__init__(space, seed=seed, learning_rate=learning_rate)
+        self.learning_rate = float(learning_rate)
+        self._point = None  # last observed params (user space)
+        self._grad = None  # its gradient, aligned with sorted param names
+
+    def suggest(self, num=1):
+        if self._point is None or self._grad is None:
+            return self.space.sample(self.next_key(), n=num)
+        names = [d.name for d in self.space.opt_dims]
+        lows_highs = dict(zip(names, self.space.interval()))
+        step = {}
+        for name, grad in zip(names, self._grad):
+            low, high = lows_highs[name]
+            value = self._point[name] - self.learning_rate * grad
+            step[name] = min(max(value, low), high)
+        extra = self.space.sample(self.next_key(), n=num - 1) if num > 1 else []
+        return [step] + extra
+
+    def observe(self, params_list, results):
+        for params, result in zip(params_list, results):
+            grad = result.get("gradient")
+            if grad is None:
+                continue  # lies / gradient-less results steer nothing
+            self._point = dict(params)
+            self._grad = [float(g) for g in grad]
+        self._n_observed += len(params_list)
+
+    def state_dict(self):
+        out = super().state_dict()
+        out["point"] = self._point
+        out["grad"] = self._grad
+        return out
+
+    def set_state(self, state):
+        super().set_state(state)
+        self._point = state["point"]
+        self._grad = state["grad"]
