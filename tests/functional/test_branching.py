@@ -186,3 +186,51 @@ def test_untracked_file_addition_branches(tmp_path):
     storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
     versions = {e["version"] for e in storage.fetch_experiments({"name": "untracked"})}
     assert versions == {1, 2}
+
+
+def test_three_generation_chain_composes_adapters(tmp_path, capsys):
+    """A grandchild must see ancestors' trials through TWO composed
+    adapter hops (v3<-v2 AND v2<-v1 prior narrowings applied in sequence),
+    and the monitoring commands must render the whole chain — the
+    single-hop branching tests cannot catch a composition bug."""
+    from orion_tpu.core.experiment import build_experiment
+
+    db = ["--storage-path", str(tmp_path / "db.pkl")]
+    for prior in ("uniform(-50, 50)", "uniform(-30, 30)", "uniform(-10, 10)"):
+        rc = cli_main(
+            ["hunt", "-n", "chain", *db, "--max-trials", "4",
+             "--worker-trials", "4", BLACK_BOX, f"-x~{prior}"]
+        )
+        assert rc == 0
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exps = {e["version"]: e for e in storage.fetch_experiments({"name": "chain"})}
+    assert set(exps) == {1, 2, 3}
+    assert exps[3]["refers"]["parent_id"] == exps[2]["_id"]
+    assert exps[2]["refers"]["parent_id"] == exps[1]["_id"]
+    assert exps[3]["refers"]["root_id"] == exps[1]["_id"]
+
+    v3 = build_experiment(storage, "chain", version=3)
+    own = v3.fetch_trials()
+    tree = v3.fetch_trials(with_evc_tree=True)
+    # Ancestors' trials inside v3's narrowed prior flow through BOTH hops;
+    # anything outside (-10, 10) must have been filtered by the composition.
+    ancestors_in_range = [
+        t
+        for v in (1, 2)
+        for t in storage.fetch_trials(uid=exps[v]["_id"])
+        if -10 <= t.params["/x"] <= 10
+    ]
+    assert len(tree) == len(own) + len(ancestors_in_range)
+    assert all(-10 <= t.params["/x"] <= 10 for t in tree)
+
+    # The chain renders: status --expand-versions shows all three versions,
+    # list shows the nested tree.  Drain output accumulated by the hunts
+    # first, so the marker assertions scope to the status command alone.
+    capsys.readouterr()
+    assert cli_main(["status", "-n", "chain", *db, "--expand-versions"]) == 0
+    out = capsys.readouterr().out
+    for marker in ("chain-v1", "chain-v2", "chain-v3"):
+        assert marker in out, f"{marker} missing from status output:\n{out}"
+    assert cli_main(["list", *db]) == 0
+    out = capsys.readouterr().out
+    assert out.count("chain") >= 3
