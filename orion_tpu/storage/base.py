@@ -155,13 +155,23 @@ class DocumentStorage(BaseStorage):
 
     def _reservation_ops(self, experiment):
         """The one reservation query/update pair — single-claim and batch
-        paths MUST write identical documents, so both build from here."""
+        paths MUST write identical documents, so both build from here.
+
+        The claim stamps ``worker`` (host:pid) — the reference declares the
+        field on Trial (`trial.py:45-46`) but never fills it; stamping at
+        the reservation CAS makes `status --all`/post-mortems attribute
+        every trial to the process that ran it."""
         now = time.time()
         query = {
             "experiment": _exp_id(experiment),
             "status": {"$in": list(RESERVABLE_STATUSES)},
         }
-        update = {"status": "reserved", "start_time": now, "heartbeat": now}
+        update = {
+            "status": "reserved",
+            "start_time": now,
+            "heartbeat": now,
+            "worker": _worker_id(),
+        }
         return query, update
 
     def reserve_trial(self, experiment):
@@ -510,6 +520,15 @@ def _trial_doc_order(doc):
     must sort with this one key, or observe order (and with it replay
     determinism) diverges between paths."""
     return (doc.get("submit_time") or 0.0, str(doc.get("_id")))
+
+
+def _worker_id():
+    """host:pid identity of this worker process (computed per call: a
+    forked/spawned child must not inherit the parent's pid stamp)."""
+    import os
+    import socket
+
+    return f"{socket.gethostname()}:{os.getpid()}"
 
 
 def _exp_id(experiment):

@@ -89,11 +89,13 @@ def test_init_only_registers_without_running(tmp_path):
 def _run_worker(db_path, name):
     from orion_tpu.cli import main as _main
 
-    _main(
+    # cli main reports failure via return code, not an exception — a child
+    # that discards it would exit 0 on a failed hunt.
+    raise SystemExit(_main(
         ["hunt", "-n", name, "--storage-path", db_path,
          "--max-trials", "10", "--worker-trials", "10",
          BLACK_BOX, "-x~uniform(-50,50)"]
-    )
+    ))
 
 
 def test_two_workers_one_db(tmp_path):
@@ -212,11 +214,11 @@ def test_broken_budget_on_final_iteration_reports_error(tmp_path):
 def _run_network_worker(conf_path, name):
     from orion_tpu.cli import main as _main
 
-    _main(
+    raise SystemExit(_main(
         ["hunt", "-n", name, "-c", conf_path,
          "--max-trials", "10", "--worker-trials", "10",
          BLACK_BOX, "-x~uniform(-50,50)"]
-    )
+    ))
 
 
 def test_two_workers_one_network_server(tmp_path):

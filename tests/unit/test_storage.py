@@ -978,3 +978,15 @@ def test_store_state_immune_to_caller_mutation():
     (fresh,) = db.read("c", {"_id": 1})
     assert fresh["status"] == "reserved"
     assert fresh["params"][0]["value"] == 0.5
+
+
+def test_reservation_stamps_worker_identity(storage):
+    """The reservation CAS must attribute the trial to this host:pid (the
+    reference declares Trial.worker but never fills it — we do)."""
+    import os
+    import socket
+
+    trial = Trial(experiment="e1", params={"/x": 1.0})
+    storage.register_trial(trial)
+    reserved = storage.reserve_trial("e1")
+    assert reserved.worker == f"{socket.gethostname()}:{os.getpid()}"
