@@ -16,7 +16,10 @@ anchor's by more than the tolerance.  The check is a hard assert AND both
 regrets are printed in the JSON line.
 
 Prints ONE json line:
-{"metric", "value", "unit", "vs_baseline", "regret", "anchor_regret"}.
+{"metric", "value", "unit", "vs_baseline", "regret", "anchor_regret",
+ "wall_ms_per_round", "device_ms_per_round", "breakdown_ms"} — the last is
+the per-stage host/device split of one steady-state round (encode, upload,
+dispatch, wait_transfer, decode, dict_build; see bench_breakdown).
 """
 
 import json
@@ -160,6 +163,58 @@ def run_anchor_regret(X0, y0):
     return float(y.min()) - GLOBAL_MIN, times
 
 
+def bench_breakdown(rounds=4):
+    """Median per-round host/device breakdown of the q=1024 boundary at the
+    steady-state shape, one stage at a time (the stages algo.observe +
+    algo.suggest run internally, replayed through the same public codec and
+    suggest-step entry points):
+
+    - encode:        observe-side dict -> unit-cube rows (params_to_cube)
+    - upload:        observe-side device append (the incremental
+                     DeviceHistory write — the only O(batch) transfer; the
+                     history itself stays resident)
+    - dispatch:      host prep + async dispatch of the fused suggest jit
+                     (includes the copula-y rebuild + its (n_pad,) upload)
+    - wait_transfer: blocking on the device result + the (q, d) transfer
+                     (device execution + this image's tunnel round trip)
+    - decode:        cube -> per-dim host arrays (decode_flat_np)
+    - dict_build:    per-dim arrays -> q param dicts (arrays_to_params)
+
+    Everything except wait_transfer is host boundary tax; regressions in
+    any stage show up in the JSON line."""
+    rng = np.random.default_rng(SEED + 2)
+    algo = _make_algo(seed=SEED + 2)
+    space = algo.space
+    X = rng.uniform(size=(130, 6)).astype(np.float32)
+    _observe(algo, X, _hartmann6_np(X))
+    algo.suggest(Q)  # compile
+
+    stages = {k: [] for k in
+              ("encode", "upload", "dispatch", "wait_transfer", "decode",
+               "dict_build")}
+    for _ in range(rounds):
+        Xn = rng.uniform(size=(16, 6)).astype(np.float32)
+        yn = _hartmann6_np(Xn)
+        params = [{f"x{i}": float(r[i]) for i in range(6)} for r in Xn]
+        t0 = time.perf_counter()
+        cube = space.params_to_cube(params)
+        t1 = time.perf_counter()
+        algo.observe(params, [{"objective": float(v)} for v in yn], cube=cube)
+        t2 = time.perf_counter()
+        rows = algo._suggest_cube(Q)
+        t3 = time.perf_counter()
+        out = np.asarray(rows)
+        t4 = time.perf_counter()
+        arrays = space.decode_flat_np(out)
+        t5 = time.perf_counter()
+        space.arrays_to_params(arrays)
+        t6 = time.perf_counter()
+        for key, dt in zip(stages, (t1 - t0, t2 - t1, t3 - t2, t4 - t3,
+                                    t5 - t4, t6 - t5)):
+            stages[key].append(dt)
+    return {k: round(1e3 * float(np.median(v)), 3) for k, v in stages.items()}
+
+
 def bench_device_decomposition():
     """Device-vs-tunnel split of one fused suggest round at the headline
     shape (two-chain-length subtraction; suggest_bench.py is the full
@@ -173,6 +228,7 @@ def bench_device_decomposition():
 
 def main():
     ours_sps = bench_throughput()
+    breakdown = bench_breakdown()
     device_ms = bench_device_decomposition()
 
     rng = np.random.default_rng(SEED)
@@ -203,6 +259,10 @@ def main():
                 # round trip + host-side transform/decode.
                 "wall_ms_per_round": round(1e3 * Q / ours_sps, 2),
                 "device_ms_per_round": round(device_ms, 2),
+                # Per-stage host/device split of one steady-state round
+                # (bench_breakdown docstring): everything except
+                # wait_transfer is host boundary tax.
+                "breakdown_ms": breakdown,
             }
         )
     )

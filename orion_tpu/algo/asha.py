@@ -116,6 +116,12 @@ class Bracket:
 class ASHA(BaseAlgorithm):
     requires_fidelity = True
 
+    # Rung bookkeeping is dict-keyed; observe() ignores the columnar rows,
+    # so the producer must not waste an encode+cache per trial on them.
+    # Model-based subclasses that DO consume cube (asha_bo, bohb) flip
+    # this back on.
+    uses_observe_cube = False
+
     # str -> int with immutable values; the naive copy only needs its own
     # dict so clone-side assignments don't leak back (base _share_dicts).
     _share_dicts = ("_bracket_of",)
@@ -163,9 +169,13 @@ class ASHA(BaseAlgorithm):
         One C-level ``repr`` of the sorted item tuples — a python-level
         ``repr(v)`` per value was ~0.5 s of a 2048-trial ackley50 sweep
         (51 dims x every observe/sample).  Dedup semantics are unchanged:
-        two params hash equal iff their sorted (name, value) reprs match."""
+        two params hash equal iff their sorted (name, value) reprs match.
+        Sorted by KEY only: param names are unique strings, and letting
+        ``sorted`` fall through to comparing values would raise TypeError
+        on heterogeneous (non-string) values."""
         items = sorted(
-            (k, v) for k, v in params.items() if k != self.fidelity_name
+            ((k, v) for k, v in params.items() if k != self.fidelity_name),
+            key=lambda kv: kv[0],
         )
         return hashlib.md5(repr(items).encode()).hexdigest()
 
@@ -267,7 +277,11 @@ class ASHA(BaseAlgorithm):
                 rung["results"][point_hash] = (None, dict(params))
                 return
 
-    def observe(self, params_list, results):
+    def observe(self, params_list, results, cube=None):
+        # ``cube`` (the columnar fast path) is accepted for contract parity
+        # with BaseAlgorithm.observe; rung bookkeeping is dict-keyed (see
+        # uses_observe_cube=False on the class — the producer doesn't even
+        # build the rows for plain ASHA/Hyperband).
         for params, result in zip(params_list, results):
             objective = result["objective"]
             fidelity = int(params.get(self.fidelity_name, 0))

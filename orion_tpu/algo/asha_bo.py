@@ -23,15 +23,18 @@ Design (BOHB-flavored, TPU-first):
 
 import logging
 
+import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.algo.asha import ASHA
 from orion_tpu.algo.base import algo_registry
+from orion_tpu.algo.history import DeviceHistory
 from orion_tpu.algo.sampling import clamp_objectives
 from orion_tpu.algo.tpu_bo import (
     copula_transform,
     local_subset_indices,
     run_suggest_step,
+    run_suggest_step_arrays,
     tr_update_batch,
 )
 from orion_tpu.parallel import device_mesh
@@ -47,6 +50,9 @@ class ASHABO(ASHA):
     the GP engages; ``n_candidates``, ``fit_steps``, ``kernel``, ``acq``,
     ``local_frac``/``local_sigma`` as in ``tpu_bo``.
     """
+
+    # Unlike plain ASHA, observe() feeds the cube rows to the GP history.
+    uses_observe_cube = True
 
     def __init__(
         self,
@@ -135,6 +141,12 @@ class ASHABO(ASHA):
         self._mf_x = np.zeros((0, d), dtype=np.float32)  # unit-cube points
         self._mf_s = np.zeros((0,), dtype=np.float32)  # normalized fidelity
         self._mf_y = np.zeros((0,), dtype=np.float32)
+        # Device-resident augmented history [x | s] (the GP's actual input
+        # columns), incrementally appended on observe — the full-history
+        # suggest path reads it in place instead of re-uploading (see
+        # orion_tpu.algo.history).  Host mirrors stay authoritative for
+        # rung bookkeeping, subset selection, and state_dict.
+        self._hist = DeviceHistory(d + 1)
         self._gp_state = None
         # Trust-region-style local radius (TuRBO-lite): the GP's global
         # signal is weak in high dimensions, so progress rides the local
@@ -145,21 +157,23 @@ class ASHABO(ASHA):
 
     # Naive-copy sharing (base __deepcopy__): the fitted GP state
     # (n_pad x n_pad Cholesky), the append-only observation arrays, and the
-    # (uncopyable) mesh handle.
+    # (uncopyable) mesh handle.  `_hist` is NOT shared by ref — its own
+    # __deepcopy__ does copy-on-write of the device buffers (see tpu_bo).
     _share_by_ref = ("space", "_gp_state", "_mf_x", "_mf_s", "_mf_y", "_mesh")
 
     # --- observation ---------------------------------------------------------
     def _fid_norm(self, fidelity):
         return (np.log(max(float(fidelity), 1.0)) - self._log_low) / self._log_span
 
-    def observe(self, params_list, results):
+    def observe(self, params_list, results, cube=None):
         super().observe(params_list, results)  # rung bookkeeping
-        valid, svals, yvals = [], [], []
-        for params, result in zip(params_list, results):
+        valid, valid_idx, svals, yvals = [], [], [], []
+        for i, (params, result) in enumerate(zip(params_list, results)):
             objective = result.get("objective")
             if objective is None:
                 continue
             valid.append(params)
+            valid_idx.append(i)
             svals.append(self._fid_norm(params.get(self.fidelity_name, 1)))
             yvals.append(float(objective))
         if not valid:
@@ -169,14 +183,20 @@ class ASHABO(ASHA):
             return
         # One batched codec call for the whole batch (q can be 4096) —
         # per-point encode would cost O(batch * dims) python overhead.
-        rows = self.space.encode_flat_np(self.space.params_to_arrays(valid))
-        self._mf_x = np.concatenate(
-            [self._mf_x, np.asarray(rows, dtype=np.float32)]
-        )
-        self._mf_s = np.concatenate(
-            [self._mf_s, np.asarray(svals, dtype=np.float32)]
-        )
-        self._mf_y = np.concatenate([self._mf_y, y.astype(np.float32)])
+        # The columnar fast path skips even that: the producer hands the
+        # params_to_cube rows it already built.
+        if cube is not None:
+            rows = np.asarray(cube, dtype=np.float32)[valid_idx]
+        else:
+            rows = self.space.params_to_cube(valid)
+        rows32 = np.asarray(rows, dtype=np.float32)
+        s32 = np.asarray(svals, dtype=np.float32)
+        y32 = y.astype(np.float32)
+        self._mf_x = np.concatenate([self._mf_x, rows32])
+        self._mf_s = np.concatenate([self._mf_s, s32])
+        self._mf_y = np.concatenate([self._mf_y, y32])
+        # Incremental device append of the augmented rows [x | s].
+        self._hist.append(np.concatenate([rows32, s32[:, None]], axis=1), y32)
         prev_best = self._best_seen
         batch_best = float(np.min(y))
         if batch_best < self._best_seen - 1e-9:
@@ -224,22 +244,7 @@ class ASHABO(ASHA):
             pool_idx = np.nonzero(top)[0]
             best_row = pool_idx[int(np.argmin(self._mf_y[pool_idx]))]
         best_x = self._mf_x[best_row]
-        x_sel, s_sel, y_raw = self._mf_x, self._mf_s, self._mf_y
-        if self.trust_region and n > self.tr_local_m:
-            # Local GP on the nearest observations (x-distance, fidelity
-            # ignored): keeps lengthscales local, Cholesky small.
-            idx = local_subset_indices(self._mf_x, best_x, self.tr_local_m)
-            x_sel, s_sel, y_raw = self._mf_x[idx], self._mf_s[idx], self._mf_y[idx]
-        y_fit = copula_transform(y_raw) if self.y_transform == "copula" else y_raw
-        # Augmented inputs [x | s]; the fused step pads/buckets internally.
-        x_aug = np.concatenate([x_sel, s_sel[:, None]], axis=1)
-        rows, state = run_suggest_step(
-            self.next_key(),
-            x_aug,
-            y_fit,
-            best_x,
-            self._gp_state,
-            num,
+        step_kw = dict(
             n_candidates=self.n_candidates,
             kernel=self.kernel,
             acq=self.acq,
@@ -259,6 +264,36 @@ class ASHABO(ASHA):
             fixed_tail_cols=1,
             mesh=self._mesh,
         )
+        if self.trust_region and n > self.tr_local_m:
+            # Local GP on the nearest observations (x-distance, fidelity
+            # ignored): keeps lengthscales local, Cholesky small.  Fresh
+            # host-side gather (bounded by tr_local_m) — keeps the upload.
+            idx = local_subset_indices(self._mf_x, best_x, self.tr_local_m)
+            x_sel, s_sel, y_raw = (
+                self._mf_x[idx], self._mf_s[idx], self._mf_y[idx]
+            )
+            y_fit = (
+                copula_transform(y_raw) if self.y_transform == "copula" else y_raw
+            )
+            # Augmented inputs [x | s]; the fused step pads/buckets internally.
+            x_aug = np.concatenate([x_sel, s_sel[:, None]], axis=1)
+            rows, state = run_suggest_step(
+                self.next_key(), x_aug, y_fit, best_x, self._gp_state, num,
+                **step_kw,
+            )
+        else:
+            # Device-resident fast path: the augmented history already lives
+            # on device; only the (rank-global) copula y, if enabled, is
+            # rebuilt and shipped per round.
+            x_dev, y_dev, mask_dev, m = self._hist.fit_view()
+            if self.y_transform == "copula":
+                y_pad = np.zeros((m,), dtype=np.float32)
+                y_pad[:n] = copula_transform(self._mf_y)
+                y_dev = jnp.asarray(y_pad)
+            rows, state = run_suggest_step_arrays(
+                self.next_key(), x_dev, y_dev, mask_dev, best_x,
+                self._gp_state, num, **step_kw,
+            )
         self._gp_state = state
         return rows
 
@@ -281,6 +316,11 @@ class ASHABO(ASHA):
         self._mf_x = np.asarray(state.get("mf_x", []), dtype=np.float32).reshape(-1, d)
         self._mf_s = np.asarray(state.get("mf_s", []), dtype=np.float32)
         self._mf_y = np.asarray(state.get("mf_y", []), dtype=np.float32)
+        # Rebuild the device-resident augmented history with one bulk upload.
+        self._hist = DeviceHistory.from_host(
+            np.concatenate([self._mf_x, self._mf_s[:, None]], axis=1),
+            self._mf_y,
+        )
         self._sigma = state.get("sigma", self.local_sigma)
         best = state.get("best_seen")
         self._best_seen = np.inf if best is None else float(best)

@@ -12,7 +12,7 @@ contract of the reference (numpy RandomState in state_dict) becomes a JAX
 PRNGKey threaded through state — seeding is explicit and resumable.
 """
 
-import functools
+from typing import NamedTuple, Optional
 
 import numpy as np
 import jax
@@ -23,15 +23,46 @@ from orion_tpu.utils.registry import Registry
 algo_registry = Registry("algo")
 
 
-@functools.lru_cache(maxsize=None)
+class SuggestionBatch(NamedTuple):
+    """One suggest round in columnar form.
+
+    ``params`` is the storage-document edge: the per-point dicts trials are
+    registered from (built ONCE, by one vectorized ``decode_flat_np`` +
+    bulk dict zip).  ``cube`` is the raw ``(n, D)`` unit-cube rows the
+    device produced, or None for host-scheduled algorithms (ASHA
+    promotions, grid cursors) that never had a cube.  Note ``cube`` is the
+    SUGGEST-time encoding; the observe-side columnar rows are defined by
+    ``Space.params_to_cube`` over the registered params (quantized dims
+    decode lossily), which is what the producer caches and feeds back.
+    """
+
+    params: list
+    #: Raw suggest-time rows, for array-native consumers (benchmarks,
+    #: custom drivers that skip the dict edge entirely).  The producer
+    #: registers trials from ``params`` and builds its observe-side rows
+    #: via ``params_to_cube`` — it does NOT feed this cube back.
+    cube: Optional[np.ndarray]
+
+
 def _effective_share(cls):
     """Union of ``_share_by_ref`` / ``_share_dicts`` over the MRO, so a
-    subclass's declaration extends rather than shadows its parents'."""
+    subclass's declaration extends rather than shadows its parents'.
+
+    Cached on the class itself (not a module-level lru_cache, which would
+    pin a strong reference to every algorithm class ever copied and keep
+    dynamically created classes — plugin reloads, test subclasses — alive
+    forever).  The ``cls.__dict__`` guard makes the cache per-class rather
+    than inherited: a subclass must not reuse its parent's union."""
+    cached = cls.__dict__.get("__effective_share__")
+    if cached is not None:
+        return cached
     ref, dicts = set(), set()
     for klass in cls.__mro__:
         ref.update(klass.__dict__.get("_share_by_ref", ()))
         dicts.update(klass.__dict__.get("_share_dicts", ()))
-    return frozenset(ref), frozenset(dicts)
+    out = (frozenset(ref), frozenset(dicts))
+    cls.__effective_share__ = out
+    return out
 
 
 class BaseAlgorithm:
@@ -61,6 +92,14 @@ class BaseAlgorithm:
     # (0.13 -> 0.21) because constant-liar lies mark the previous batch's
     # genuinely-good region as bad.
     speculation_safe = False
+
+    # True when observe() actually consumes the columnar ``cube`` rows.
+    # Algorithms whose observation handling is purely dict-keyed (ASHA's
+    # rung bookkeeping) set this False so the producer skips building and
+    # caching cube rows it would only throw away.  Orthogonal to signature
+    # compatibility: the producer ALSO sniffs the observe signature, so
+    # pre-columnar plugin overrides fall back to the dict path either way.
+    uses_observe_cube = True
 
     # The producer deepcopies the algorithm every round for its naive copy
     # (lie fantasization); these class attributes let subclasses exempt
@@ -137,17 +176,51 @@ class BaseAlgorithm:
         self._n_observed = state["n_observed"]
 
     # --- core contract -----------------------------------------------------
+    def _materialize_batch(self, cube):
+        """Decode a device cube to a :class:`SuggestionBatch`: ONE bulk
+        device->host transfer, then host-side decode — per-dimension device
+        decode would pay a host<->device round trip per dim
+        (orion_tpu.space.dims host codec mirror)."""
+        cube = np.asarray(cube, dtype=np.float32)
+        arrays = self.space.decode_flat_np(cube)
+        params = self.space.arrays_to_params(
+            arrays, fidelity_value=self._fidelity_for_new()
+        )
+        return SuggestionBatch(params, cube)
+
     def suggest(self, num=1):
         """Return ``num`` new points as a list of param dicts, or None to
-        signal a temporary opt-out (producer backs off and retries)."""
+        signal a temporary opt-out (producer backs off and retries).
+
+        Deliberately does NOT route through :meth:`suggest_batch`: a
+        subclass override of ``suggest`` that delegates to
+        ``super().suggest()`` must reach this implementation directly
+        (suggest_batch routes overriders back to ``self.suggest`` — going
+        through it here would make that pattern infinitely recursive).
+        """
         cube = self._suggest_cube(num)
         if cube is None:
             return None
-        # ONE bulk device->host transfer of the cube, then host-side decode:
-        # per-dimension device decode would pay a host<->device round trip
-        # per dim (orion_tpu.space.dims host codec mirror).
-        arrays = self.space.decode_flat_np(np.asarray(cube))
-        return self.space.arrays_to_params(arrays, fidelity_value=self._fidelity_for_new())
+        return self._materialize_batch(cube).params
+
+    def suggest_batch(self, num=1):
+        """Columnar twin of :meth:`suggest`: returns a
+        :class:`SuggestionBatch` (params + the raw cube rows) or None on
+        opt-out.  This is the producer's entry point — suggestions flow as
+        arrays and the per-point dicts are built exactly once, at the
+        storage-document edge.
+
+        Algorithms that override ``suggest`` itself with host-side
+        scheduling (ASHA's promotions, grid cursors, plugins) are routed
+        through their override and yield ``cube=None``.
+        """
+        if type(self).suggest is not BaseAlgorithm.suggest:
+            params = self.suggest(num)
+            return SuggestionBatch(params, None) if params is not None else None
+        cube = self._suggest_cube(num)
+        if cube is None:
+            return None
+        return self._materialize_batch(cube)
 
     def _suggest_cube(self, num):
         raise NotImplementedError
@@ -168,12 +241,24 @@ class BaseAlgorithm:
         return (num, cube)
 
     def finalize_suggest(self, handle):
-        """Force a :meth:`dispatch_suggest` handle to concrete params."""
+        """Force a :meth:`dispatch_suggest` handle to concrete params.
+
+        Like :meth:`suggest`, this is the direct implementation — it must
+        not route through the batch twin, so subclass overrides delegating
+        to ``super().finalize_suggest()`` cannot recurse."""
         num, cube = handle
-        arrays = self.space.decode_flat_np(np.asarray(cube)[:num])
-        return self.space.arrays_to_params(
-            arrays, fidelity_value=self._fidelity_for_new()
-        )
+        return self._materialize_batch(np.asarray(cube)[:num]).params
+
+    def finalize_suggest_batch(self, handle):
+        """Columnar finalize: force a :meth:`dispatch_suggest` handle to a
+        :class:`SuggestionBatch` — the dict build happens here, at the
+        storage edge, once.  Plugins that override ``finalize_suggest``
+        itself (custom handles / post-processing) are routed through their
+        override and yield ``cube=None``."""
+        if type(self).finalize_suggest is not BaseAlgorithm.finalize_suggest:
+            return SuggestionBatch(self.finalize_suggest(handle), None)
+        num, cube = handle
+        return self._materialize_batch(np.asarray(cube)[:num])
 
     def _fidelity_for_new(self):
         """Fidelity assigned to fresh points (max budget unless multi-fidelity
@@ -181,17 +266,32 @@ class BaseAlgorithm:
         fid = self.space.fidelity
         return fid.high if fid is not None else None
 
-    def observe(self, params_list, results):
+    def observe(self, params_list, results, cube=None):
         """Feed evaluated points back.
 
         ``results`` is a list of dicts with at least ``objective`` (reference
         `base.py:165-191`).  The default implementation encodes points to the
         unit cube and forwards to :meth:`observe_arrays`.
+
+        ``cube`` is the columnar fast path: pre-encoded ``(n, D)`` unit-cube
+        rows for ``params_list``, as produced by ``Space.params_to_cube``
+        (the producer caches these per trial).  When given, the per-point
+        dict parse + encode is skipped entirely; the rows MUST be the
+        ``params_to_cube`` encoding — feeding anything else (e.g. raw
+        suggest-time cube rows for quantized dims) would diverge from the
+        dict path.
         """
         if not params_list:
             return
-        arrays = self.space.params_to_arrays(params_list)
-        cube = self.space.encode_flat_np(arrays)
+        if cube is None:
+            cube = self.space.params_to_cube(params_list)
+        else:
+            cube = np.asarray(cube, dtype=np.float32)
+            if cube.shape[0] != len(params_list):
+                raise ValueError(
+                    f"cube has {cube.shape[0]} rows for "
+                    f"{len(params_list)} params"
+                )
         objectives = np.asarray(
             [float(r["objective"]) for r in results], dtype=np.float64
         )

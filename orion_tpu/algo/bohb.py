@@ -31,6 +31,9 @@ class BOHB(Hyperband):
     modeled; default ``dims + 2``).
     """
 
+    # Unlike plain ASHA/Hyperband, observe() feeds cube rows to the KDE tiers.
+    uses_observe_cube = True
+
     def __init__(
         self,
         space,
@@ -72,23 +75,28 @@ class BOHB(Hyperband):
     _share_dicts = ("_tier_x", "_tier_y")
 
     # --- observation --------------------------------------------------------
-    def observe(self, params_list, results):
+    def observe(self, params_list, results, cube=None):
         super().observe(params_list, results)  # rung/promotion bookkeeping
         by_tier = {}
-        for params, result in zip(params_list, results):
+        for i, (params, result) in enumerate(zip(params_list, results)):
             objective = result.get("objective")
             if objective is None:
                 continue
             tier = int(params.get(self.fidelity_name, 1))
-            by_tier.setdefault(tier, ([], []))
+            by_tier.setdefault(tier, ([], [], []))
             by_tier[tier][0].append(params)
             by_tier[tier][1].append(float(objective))
-        for tier, (valid, yvals) in by_tier.items():
+            by_tier[tier][2].append(i)
+        for tier, (valid, yvals, idx) in by_tier.items():
             prev_y = self._tier_y.get(tier, np.zeros((0,), dtype=np.float32))
             y = clamp_objectives(np.asarray(yvals, dtype=np.float64), prev_y)
             if y is None:
                 continue
-            rows = self.space.encode_flat_np(self.space.params_to_arrays(valid))
+            # Columnar fast path: reuse the producer's params_to_cube rows.
+            if cube is not None:
+                rows = np.asarray(cube, dtype=np.float32)[idx]
+            else:
+                rows = self.space.params_to_cube(valid)
             prev_x = self._tier_x.get(
                 tier, np.zeros((0, self.space.n_cols), dtype=np.float32)
             )

@@ -196,3 +196,14 @@ class DifferentialEvolution(BaseAlgorithm):
         self._pop = np.asarray(state["pop"], dtype=np.float32).reshape(-1, d)
         self._fit = np.asarray(state["fit"], dtype=np.float32)
         self._n_filled = int(state["n_filled"])
+        # The restored arrays ARE the population: a state saved under a
+        # different popsize config must not leave self.popsize pointing past
+        # (or short of) the actual rows — the seeding phase writes at
+        # self._pop[self._n_filled] and would IndexError past a smaller
+        # restored population.
+        if self._pop.shape[0] != self._fit.shape[0]:
+            raise ValueError(
+                "inconsistent DE state: pop has "
+                f"{self._pop.shape[0]} rows but fit has {self._fit.shape[0]}"
+            )
+        self.popsize = self._pop.shape[0]

@@ -1,0 +1,187 @@
+"""Device-resident observation history with incremental in-place appends.
+
+The GP algorithms (`tpu_bo`, `asha_bo`) fit on the full observation history
+every round.  Re-padding that history on host and re-uploading it with
+``jnp.asarray`` per suggest costs O(n) transfer per round — O(n²) cumulative
+over an experiment — for rows the device has already seen.  This module
+keeps the history in preallocated power-of-2-padded device buffers owned by
+the algorithm and appends each observe batch in place with one small
+``dynamic_update_slice`` jit whose input buffers are donated (XLA aliases
+them, so no copy of the resident history is made).  Only the new rows cross
+the host→device boundary.
+
+Invariants (what makes the incremental path bit-equal to a full re-upload):
+
+- Buffer capacity is a power of 2 (floor 64, the GP pad floor) and only
+  grows; every row at index >= ``count`` is exactly 0.0 in x and y with
+  mask 0.0 — identical to the zero-padding a host re-pad produces.
+- :meth:`fit_view` returns views sliced to ``_next_pow2(count)``, the exact
+  shape the host re-upload path pads to, so the fused suggest jit sees the
+  same shapes, same values, same jit bucket — and therefore returns
+  bit-identical suggestions (the regression test in
+  ``tests/unit/test_device_history.py`` pins this across a pow-2 growth
+  boundary).
+
+Naive-copy discipline (the producer deepcopies the algorithm every round to
+fantasize lies): donation would invalidate a buffer the clone still
+references, so ``__deepcopy__`` hands the clone the same buffers and marks
+BOTH sides copy-on-write — the next append on either side runs the
+non-donating twin of the update jit (the other side's view survives), after
+which the appender exclusively owns its fresh buffers and donation resumes.
+A bench- or client-driven algorithm that is never cloned donates on every
+append.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _next_pow2(n, floor=64):
+    out = floor
+    while out < n:
+        out *= 2
+    return out
+
+
+#: Append batches are padded to a power of 2 (floor 8) so the update jit
+#: compiles once per (capacity, batch-bucket) pair instead of once per
+#: distinct batch size (the producer's retry loop shrinks its request).
+_BATCH_FLOOR = 8
+
+
+def _donation_supported():
+    # CPU ignores buffer donation and warns per compile; skip it there (the
+    # tests run JAX_PLATFORMS=cpu).  Accelerator backends — including this
+    # image's remote tunnel — take the alias.
+    return jax.default_backend() != "cpu"
+
+
+def _append_impl(x, y, mask, rows, ys, mvals, n):
+    x = jax.lax.dynamic_update_slice(x, rows, (n, jnp.int32(0)))
+    y = jax.lax.dynamic_update_slice(y, ys, (n,))
+    mask = jax.lax.dynamic_update_slice(mask, mvals, (n,))
+    return x, y, mask
+
+
+# Donating twin: in-place update of the resident buffers (no O(capacity)
+# copy per observe).  Copying twin: used under copy-on-write and on CPU.
+_append_donating = jax.jit(_append_impl, donate_argnums=(0, 1, 2))
+_append_copying = jax.jit(_append_impl)
+
+
+@partial(jax.jit, static_argnames=("new_cap",))
+def _grow(x, y, mask, new_cap):
+    pad = new_cap - x.shape[0]
+    return (
+        jnp.pad(x, ((0, pad), (0, 0))),
+        jnp.pad(y, (0, pad)),
+        jnp.pad(mask, (0, pad)),
+    )
+
+
+class DeviceHistory:
+    """Pow-2-padded device buffers ``(x, y, mask)`` for one observation set.
+
+    ``append`` is the only mutator; ``fit_view`` is the only reader the hot
+    path needs.  ``count`` is the number of real rows; everything past it is
+    zero (see module docstring for why that exact invariant matters).
+    """
+
+    def __init__(self, n_cols, floor=64):
+        self.n_cols = int(n_cols)
+        self.floor = int(floor)
+        self.count = 0
+        self.cap = 0
+        self._x = None
+        self._y = None
+        self._mask = None
+        # True while the buffers may be visible to another DeviceHistory
+        # (a naive-copy clone): the next append must not donate them.
+        self._cow = False
+
+    @classmethod
+    def from_host(cls, x, y, floor=64):
+        """Bulk-build from host mirrors (state restore / resume)."""
+        x = np.asarray(x, dtype=np.float32)
+        hist = cls(x.shape[1] if x.ndim == 2 else 0, floor=floor)
+        if x.shape[0]:
+            hist.append(x, np.asarray(y, dtype=np.float32))
+        return hist
+
+    def __deepcopy__(self, memo):
+        clone = DeviceHistory.__new__(DeviceHistory)
+        clone.__dict__.update(self.__dict__)
+        # Both sides now share the device buffers: whichever appends first
+        # must copy-on-write so the other side's rows survive.
+        clone._cow = True
+        self._cow = True
+        memo[id(self)] = clone
+        return clone
+
+    def _ensure_capacity(self, need):
+        new_cap = _next_pow2(need, floor=self.floor)
+        if self._x is None:
+            self._x = jnp.zeros((new_cap, self.n_cols), jnp.float32)
+            self._y = jnp.zeros((new_cap,), jnp.float32)
+            self._mask = jnp.zeros((new_cap,), jnp.float32)
+        elif new_cap > self.cap:
+            self._x, self._y, self._mask = _grow(
+                self._x, self._y, self._mask, new_cap=new_cap
+            )
+        else:
+            return
+        self.cap = new_cap
+        self._cow = False  # fresh buffers are exclusively ours
+
+    def append(self, rows, ys):
+        """Write an observe batch at ``count``; one device dispatch.
+
+        The batch is zero-padded to a pow-2 bucket before upload; the
+        padding rows land in the region past ``count`` with mask 0.0,
+        preserving the all-zeros-past-count invariant.
+        """
+        rows = np.asarray(rows, dtype=np.float32).reshape(-1, self.n_cols)
+        ys = np.asarray(ys, dtype=np.float32).reshape(-1)
+        b = rows.shape[0]
+        if b == 0:
+            return
+        b_pad = _next_pow2(b, floor=_BATCH_FLOOR)
+        mvals = np.zeros((b_pad,), dtype=np.float32)
+        mvals[:b] = 1.0
+        if b_pad != b:
+            rows = np.concatenate(
+                [rows, np.zeros((b_pad - b, self.n_cols), np.float32)]
+            )
+            ys = np.concatenate([ys, np.zeros((b_pad - b,), np.float32)])
+        # Capacity must cover the PADDED write: dynamic_update_slice clamps
+        # out-of-range starts, which would silently shift the write onto
+        # valid rows.
+        self._ensure_capacity(self.count + b_pad)
+        fn = (
+            _append_donating
+            if not self._cow and _donation_supported()
+            else _append_copying
+        )
+        self._x, self._y, self._mask = fn(
+            self._x,
+            self._y,
+            self._mask,
+            jnp.asarray(rows),
+            jnp.asarray(ys),
+            jnp.asarray(mvals),
+            jnp.int32(self.count),
+        )
+        self._cow = False
+        self.count += b
+
+    def fit_view(self):
+        """``(x, y, mask, m)`` sliced to ``m = _next_pow2(count)`` — the
+        exact padded shape the host re-upload path produces, regardless of
+        how far capacity has grown ahead (growth is batch-bucket eager)."""
+        m = _next_pow2(max(self.count, 1), floor=self.floor)
+        if m == self.cap:
+            return self._x, self._y, self._mask, m
+        return self._x[:m], self._y[:m], self._mask[:m], m

@@ -27,15 +27,9 @@ from orion_tpu.algo.gp.acquisition import (
     select_q,
 )
 from orion_tpu.algo.gp.gp import fit_gp, init_hypers, posterior_norm
+from orion_tpu.algo.history import DeviceHistory, _next_pow2
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
 from orion_tpu.parallel import candidate_sharding, device_mesh
-
-
-def _next_pow2(n, floor=64):
-    out = floor
-    while out < n:
-        out *= 2
-    return out
 
 
 def copula_transform(y):
@@ -245,6 +239,13 @@ class TPUBO(BaseAlgorithm):
         d = space.n_cols
         self._x = np.zeros((0, d), dtype=np.float32)
         self._y = np.zeros((0,), dtype=np.float32)
+        # Device-resident twin of (_x, _y): incrementally appended on
+        # observe so the full-history suggest path never re-uploads rows
+        # the device already holds (docs/algorithms.md, "Device-resident
+        # history").  The host mirrors stay the source of truth for
+        # trust-region bookkeeping, local-subset selection, the copula
+        # transform, and state_dict.
+        self._hist = DeviceHistory(d)
         self._gp_state = None
         self._tr_length = tr_length_init
         self._tr_succ = 0
@@ -255,7 +256,10 @@ class TPUBO(BaseAlgorithm):
 
     # Naive-copy sharing (base __deepcopy__): the mesh handle is not
     # copyable and the fitted GP state / observation buffers are
-    # immutable-by-rebinding.
+    # immutable-by-rebinding.  `_hist` is deliberately NOT here: its own
+    # __deepcopy__ implements copy-on-write sharing of the device buffers
+    # (a plain by-ref share would let the clone's donated in-place appends
+    # clobber the real algorithm's history).
     _share_by_ref = ("space", "_mesh", "_gp_state", "_x", "_y")
 
     # --- observation --------------------------------------------------------
@@ -265,8 +269,12 @@ class TPUBO(BaseAlgorithm):
             return
         prev_n = self._y.shape[0]
         prev_best = float(np.min(self._y)) if prev_n else np.inf
-        self._x = np.concatenate([self._x, np.asarray(cube, dtype=np.float32)])
-        self._y = np.concatenate([self._y, np.asarray(objectives, dtype=np.float32)])
+        rows32 = np.asarray(cube, dtype=np.float32)
+        y32 = np.asarray(objectives, dtype=np.float32)
+        self._x = np.concatenate([self._x, rows32])
+        self._y = np.concatenate([self._y, y32])
+        # Incremental device append: only the new rows cross the boundary.
+        self._hist.append(rows32, y32)
         # Trust-region bookkeeping counts MODEL rounds only: observations of
         # the random init phase say nothing about the local model's quality.
         if self.trust_region and prev_n >= self.n_init:
@@ -325,23 +333,7 @@ class TPUBO(BaseAlgorithm):
             else int(np.argmin(self._y))
         )
         best_x = self._x[center_idx]
-        x_fit, y_raw = self._x, self._y
-        if self.trust_region and self._x.shape[0] > self.tr_local_m:
-            # LOCAL GP (the TuRBO design): fit only the tr_local_m nearest
-            # observations to the incumbent.  A global fit has to average
-            # lengthscales over the whole landscape, washing out exactly the
-            # local structure the trust region is trying to exploit — and a
-            # 4x smaller buffer makes the per-round Cholesky ~64x cheaper.
-            idx = local_subset_indices(self._x, best_x, self.tr_local_m)
-            x_fit, y_raw = self._x[idx], self._y[idx]
-        y_fit = copula_transform(y_raw) if self.y_transform == "copula" else y_raw
-        rows, state = run_suggest_step(
-            self.next_key(),
-            x_fit,
-            y_fit,
-            best_x,
-            self._gp_state,
-            num,
+        step_kw = dict(
             n_candidates=self.n_candidates,
             kernel=self.kernel,
             acq=self.acq,
@@ -355,6 +347,38 @@ class TPUBO(BaseAlgorithm):
             tr_perturb_dims=self.tr_perturb_dims,
             mesh=self._mesh,
         )
+        if self.trust_region and self._x.shape[0] > self.tr_local_m:
+            # LOCAL GP (the TuRBO design): fit only the tr_local_m nearest
+            # observations to the incumbent.  A global fit has to average
+            # lengthscales over the whole landscape, washing out exactly the
+            # local structure the trust region is trying to exploit — and a
+            # 4x smaller buffer makes the per-round Cholesky ~64x cheaper.
+            # The fit set is a fresh host-side gather (bounded by
+            # tr_local_m, not O(n)), so this path keeps the host upload.
+            idx = local_subset_indices(self._x, best_x, self.tr_local_m)
+            x_fit, y_raw = self._x[idx], self._y[idx]
+            y_fit = (
+                copula_transform(y_raw) if self.y_transform == "copula" else y_raw
+            )
+            rows, state = run_suggest_step(
+                self.next_key(), x_fit, y_fit, best_x, self._gp_state, num,
+                **step_kw,
+            )
+        else:
+            # Device-resident fast path: the fit set IS the full history,
+            # which already lives on device — no O(n) re-pad or re-upload.
+            # Only the copula-transformed y (whose ranks change globally
+            # with every new observation) is rebuilt on host and shipped,
+            # an O(n) vector next to the O(n·d) x re-upload this replaces.
+            x_dev, y_dev, mask_dev, m = self._hist.fit_view()
+            if self.y_transform == "copula":
+                y_pad = np.zeros((m,), dtype=np.float32)
+                y_pad[:n] = copula_transform(self._y)
+                y_dev = jnp.asarray(y_pad)
+            rows, state = run_suggest_step_arrays(
+                self.next_key(), x_dev, y_dev, mask_dev, best_x,
+                self._gp_state, num, **step_kw,
+            )
         self._gp_state = state
         return rows
 
@@ -372,6 +396,9 @@ class TPUBO(BaseAlgorithm):
         d = self.space.n_cols
         self._x = np.asarray(state["x"], dtype=np.float32).reshape(-1, d)
         self._y = np.asarray(state["y"], dtype=np.float32)
+        # Rebuild the device-resident twin with ONE bulk upload; incremental
+        # appends resume from here.
+        self._hist = DeviceHistory.from_host(self._x, self._y)
         self._gp_state = None  # refit (cold) on the next suggest
         tr = state.get("tr")
         if tr is not None:
@@ -572,12 +599,12 @@ def run_suggest_step(
     fixed_tail_cols=0,
     mesh=None,
 ):
-    """Host wrapper around the fused jit: pow-2 pad the observation buffers,
-    warm-start from a previous GPState (warm refits run ``refit_steps``
-    optimizer steps, cold first fits ``fit_steps``), bucket q (a static arg
-    — the producer's retry loop shrinks its request per round and each
-    distinct q would otherwise recompile the whole graph), and slice the
-    rows back.  Shared by ``tpu_bo`` and the multi-fidelity ``asha_bo``.
+    """Host wrapper around the fused jit: pow-2 pad the observation buffers
+    on host, upload, and delegate to :func:`run_suggest_step_arrays`.  Used
+    by the local-subset (trust-region) path, whose fit set is a fresh
+    host-side gather each round; the full-history path goes through the
+    algorithm's device-resident :class:`DeviceHistory` instead and never
+    re-uploads rows the device already holds.
     """
     n, width = np.asarray(x_obs).shape
     n_pad = _next_pow2(n)
@@ -587,14 +614,71 @@ def run_suggest_step(
     x[:n] = x_obs
     y[:n] = y_obs
     mask[:n] = 1.0
+    return run_suggest_step_arrays(
+        key,
+        jnp.asarray(x),
+        jnp.asarray(y),
+        jnp.asarray(mask),
+        best_x,
+        warm_state,
+        num,
+        n_candidates=n_candidates,
+        kernel=kernel,
+        acq=acq,
+        fit_steps=fit_steps,
+        refit_steps=refit_steps,
+        local_frac=local_frac,
+        local_sigma=local_sigma,
+        beta=beta,
+        trust_region=trust_region,
+        tr_length=tr_length,
+        tr_perturb_dims=tr_perturb_dims,
+        fixed_tail_cols=fixed_tail_cols,
+        mesh=mesh,
+    )
+
+
+def run_suggest_step_arrays(
+    key,
+    x,
+    y,
+    mask,
+    best_x,
+    warm_state,
+    num,
+    *,
+    n_candidates,
+    kernel,
+    acq,
+    fit_steps,
+    refit_steps=None,
+    local_frac,
+    local_sigma,
+    beta,
+    trust_region=False,
+    tr_length=None,
+    tr_perturb_dims=20,
+    fixed_tail_cols=0,
+    mesh=None,
+):
+    """Device-array entry to the fused jit: ``(x, y, mask)`` are already
+    pow-2-padded device (or device-ready) buffers — typically
+    ``DeviceHistory.fit_view`` slices, so no O(n) host re-pad or re-upload
+    happens here.  Warm-starts from a previous GPState (warm refits run
+    ``refit_steps`` optimizer steps, cold first fits ``fit_steps``) and
+    buckets q (a static arg — the producer's retry loop shrinks its request
+    per round and each distinct q would otherwise recompile the whole
+    graph).  Shared by ``tpu_bo`` and the multi-fidelity ``asha_bo``.
+    """
+    width = x.shape[1]
     warm = warm_state.hypers if warm_state is not None else init_hypers(width)
     if warm_state is not None and refit_steps is not None:
         fit_steps = refit_steps
     rows, state = _suggest_step(
         key,
-        jnp.asarray(x),
-        jnp.asarray(y),
-        jnp.asarray(mask),
+        x,
+        y,
+        mask,
         jnp.asarray(best_x),
         warm,
         # Dynamic (traced) so success/failure box resizing never recompiles;

@@ -9,9 +9,12 @@ a `max_idle_time` guard against algorithms that stop producing new points.
 """
 
 import copy
+import inspect
 import logging
 import random as _random
 import time
+
+import numpy as np
 
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Result, Trial
 from orion_tpu.utils.exceptions import (
@@ -21,6 +24,21 @@ from orion_tpu.utils.exceptions import (
 )
 
 log = logging.getLogger(__name__)
+
+
+def _observe_accepts_cube(algo):
+    """True when the algorithm's ``observe`` takes the columnar ``cube``
+    kwarg (the BaseAlgorithm contract).  Pre-columnar third-party plugins
+    that override ``observe(params_list, results)`` keep working through
+    the dict path."""
+    try:
+        sig = inspect.signature(type(algo).observe)
+    except (TypeError, ValueError):  # pragma: no cover - exotic callables
+        return False
+    return any(
+        p.name == "cube" or p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in sig.parameters.values()
+    )
 
 
 class Producer:
@@ -38,6 +56,25 @@ class Producer:
         self.naive_algorithm = None
         self._observed_ids = set()  # replaces reference TrialsHistory dedup
         self._leaf_ids = []  # lineage: children of observed DAG (trials_history.py)
+        # Columnar observe cache: trial id -> (D,) float32 unit-cube row
+        # (Space.params_to_cube encoding).  Lies re-observe every in-flight
+        # trial every round; without this each round re-parses O(in-flight)
+        # param dicts through the codec.  Keyed by the STORAGE trial id —
+        # a stored string on fetched trials, so cache lookups never pay the
+        # md5-over-params hash_params would recompute per access.  Rows are
+        # evicted once their trial completes and feeds the real algorithm
+        # (never needed again — _observed_ids gates re-observation) and
+        # swept for stopped trials.
+        self._cube_cache = {}
+        # Third-party plugins may predate the columnar contract and override
+        # observe(params_list, results) without the cube kwarg — detect once
+        # and fall back to the dict path for them (same semantics, slower).
+        # Algorithms that declare uses_observe_cube=False (purely dict-keyed
+        # observation handling, e.g. ASHA rung bookkeeping) skip the cube
+        # build/cache too — it would be pure waste for them.
+        self._observe_takes_cube = getattr(
+            self.algorithm, "uses_observe_cube", True
+        ) and _observe_accepts_cube(self.algorithm)
         self.failure_count = 0
         self._n_in_flight = 0  # status == reserved (someone is executing)
         self._n_reservable = 0  # new/suspended/interrupted (worker can consume)
@@ -102,6 +139,16 @@ class Producer:
         self._n_in_flight = sum(t.status == "reserved" for t in own)
         self._n_reservable = sum(t.status in RESERVABLE_STATUSES for t in own)
         self._update_algorithm(completed)
+        # Bound the columnar cache: stopped trials are never lied about
+        # again, so their rows are dead weight.  Completed-with-objective
+        # trials were just observed (and evicted) above; this sweep covers
+        # broken / interrupted / objective-less terminals, which would
+        # otherwise leak one row per failed trial forever.  (A resumed
+        # interrupted trial simply re-encodes on its next cache miss.)
+        if self._cube_cache:
+            for t in trials:
+                if t.is_stopped:
+                    self._cube_cache.pop(t.id, None)
         self._update_naive_algorithm(incomplete)
         self._flush_timings()
 
@@ -110,13 +157,43 @@ class Producer:
         if fresh:
             params = [t.params for t in fresh]
             results = [_trial_results(t) for t in fresh]
+            cube = self._cube_rows_for(fresh)
             t0 = time.perf_counter()
-            self.algorithm.observe(params, results)
+            if cube is not None:
+                self.algorithm.observe(params, results, cube=cube)
+            else:  # pre-columnar plugin signature
+                self.algorithm.observe(params, results)
             self._record_timing("observe", time.perf_counter() - t0, len(fresh))
             self.strategy.observe(params, results)
             for t in fresh:
                 self._observed_ids.add(t.id)
+                self._cube_cache.pop(t.id, None)
             self._leaf_ids = [t.id for t in fresh]
+
+    def _cube_rows_for(self, trials):
+        """(n, D) columnar rows for ``trials`` — cache hits plus ONE bulk
+        ``params_to_cube`` call for the misses.  Bit-identical to the
+        per-call dict encode the algorithms would otherwise run (same
+        single pipeline, row-independent codec), so the columnar and dict
+        observe paths cannot diverge.  Returns None (dict fallback) for
+        pre-columnar plugin algorithms."""
+        if not self._observe_takes_cube:
+            return None
+        space = self.algorithm.space
+        rows = [self._cube_cache.get(t.id) for t in trials]
+        missing = [i for i, r in enumerate(rows) if r is None]
+        if missing:
+            encoded = space.params_to_cube([trials[i].params for i in missing])
+            for j, i in enumerate(missing):
+                # Copy each row out: a view into `encoded` would pin the
+                # whole (n_missing, D) batch for as long as any one row
+                # survives in the cache.
+                row = np.array(encoded[j])
+                self._cube_cache[trials[i].id] = row
+                rows[i] = row
+        if not rows:
+            return None
+        return np.stack(rows)
 
     def _record_timing(self, op, duration, count):
         """Buffer a timing sample; flushed once per produce()/update() round
@@ -137,13 +214,26 @@ class Producer:
         """Naive algo = deepcopy of real + lies for in-flight trials
         (reference `producer.py:159-174`)."""
         self.naive_algorithm = copy.deepcopy(self.algorithm)
-        lying_trials = self._produce_lies(incomplete)
-        if lying_trials:
-            params = [t.params for t in lying_trials]
-            results = [{"objective": t.lie.value} for t in lying_trials]
-            self.naive_algorithm.observe(params, results)
+        lying = self._produce_lies(incomplete)
+        if lying:
+            params = [lt.params for _, lt in lying]
+            results = [{"objective": lt.lie.value} for _, lt in lying]
+            # Columnar: lies re-feed every in-flight point every round, so
+            # this is the hottest dict->cube boundary in the loop — row
+            # cache + one bulk encode for first-seen points.  Keyed by the
+            # SOURCE trial (its storage id is a stored string; the lying
+            # twin's id would be a fresh md5 per access AND would never
+            # match the eviction sweep's keys).
+            cube = self._cube_rows_for([src for src, _ in lying])
+            if cube is not None:
+                self.naive_algorithm.observe(params, results, cube=cube)
+            else:  # pre-columnar plugin signature
+                self.naive_algorithm.observe(params, results)
 
     def _produce_lies(self, incomplete):
+        """(source_trial, lying_trial) pairs for every liable in-flight
+        trial — the source carries the storage identity, the lying twin the
+        fantasy result."""
         lying = []
         for trial in incomplete:
             lie = self.strategy.lie(trial)
@@ -158,7 +248,7 @@ class Producer:
                 self.experiment.register_lie(lying_trial)
             except DuplicateKeyError:
                 pass  # lie already registered in a previous round
-            lying.append(lying_trial)
+            lying.append((trial, lying_trial))
         return lying
 
     # --- production ---------------------------------------------------------
@@ -187,7 +277,13 @@ class Producer:
                 # Already timed by _take_speculative (the residual transfer).
                 suggested, speculative = speculative, None
             else:
-                suggested = self.naive_algorithm.suggest(pool_size - registered)
+                # Columnar flow: the suggestion crosses the boundary as a
+                # (q, d) array; the per-point dicts in batch.params are the
+                # storage-document edge, built once inside suggest_batch.
+                batch = self.naive_algorithm.suggest_batch(
+                    pool_size - registered
+                )
+                suggested = batch.params if batch is not None else None
                 # Advance ONLY the real algo's RNG stream, never its full
                 # state: the naive copy has observed fantasy lies, and
                 # syncing its whole state_dict would permanently inject
@@ -214,7 +310,10 @@ class Producer:
                     self._sleep_backoff()
                     continue
                 t0 = time.perf_counter()
-                suggested = self.naive_algorithm.suggest(pool_size - registered)
+                batch = self.naive_algorithm.suggest_batch(
+                    pool_size - registered
+                )
+                suggested = batch.params if batch is not None else None
                 self.algorithm.rng_key = self.naive_algorithm.rng_key
                 if suggested is None:
                     # Nothing pending, nothing running, and a fresh-state
@@ -256,6 +355,12 @@ class Producer:
                 else:
                     self.algorithm.register_suggestion(trial.params)
                     registered += 1
+                    # Freeze the id: params/experiment are final once the
+                    # trial is durably registered, and the speculative lie
+                    # path + cube cache key by id — without this, every
+                    # .id access on a locally-built Trial recomputes the
+                    # md5 the columnar cache exists to avoid.
+                    trial._id_override = trial.id
                     registered_trials.append(trial)
             if batch_error is not None:
                 raise batch_error
@@ -293,13 +398,19 @@ class Producer:
                 # written and pay a round of DuplicateKeyError + backoff.
                 for trial in registered_trials:
                     algo.register_suggestion(trial.params)
-                lies = []
+                lie_trials, lie_results = [], []
                 for trial in registered_trials:
                     lie = self.strategy.lie(trial)
                     if lie is not None and lie.value is not None:
-                        lies.append((dict(trial.params), {"objective": lie.value}))
-                if lies:
-                    algo.observe([p for p, _ in lies], [r for _, r in lies])
+                        lie_trials.append(trial)
+                        lie_results.append({"objective": lie.value})
+                if lie_trials:
+                    lie_params = [dict(t.params) for t in lie_trials]
+                    lie_cube = self._cube_rows_for(lie_trials)
+                    if lie_cube is not None:
+                        algo.observe(lie_params, lie_results, cube=lie_cube)
+                    else:  # pre-columnar plugin signature
+                        algo.observe(lie_params, lie_results)
             handle = algo.dispatch_suggest(pool_size)
         except Exception:  # pragma: no cover - speculation must never break a run
             log.debug("speculative dispatch failed", exc_info=True)
@@ -318,7 +429,7 @@ class Producer:
         handle, algo = spec
         try:
             t0 = time.perf_counter()
-            out = algo.finalize_suggest(handle)[:pool_size]
+            out = algo.finalize_suggest_batch(handle).params[:pool_size]
             # Timed as "suggest": what remains of the device round trip
             # after the overlap (ideally just the residual transfer).
             self._record_timing("suggest", time.perf_counter() - t0, len(out))

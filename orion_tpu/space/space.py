@@ -228,6 +228,18 @@ class Space:
                 columns.append(dim.cast_column(col))
         return [dict(zip(names, row)) for row in zip(*columns)] if names else []
 
+    def params_to_cube(self, params_list):
+        """List of structured param dicts -> (n, D) float32 unit-cube rows.
+
+        THE canonical dict->cube pipeline (``params_to_arrays`` +
+        ``encode_flat_np``), factored so every observe-side caller — the
+        algorithm base class, the producer's columnar cache, the
+        multi-fidelity algorithms — produces bit-identical rows for the
+        same params.  The columnar fast path's equivalence guarantee
+        (docs/algorithms.md) leans on this single definition.
+        """
+        return self.encode_flat_np(self.params_to_arrays(params_list))
+
     def params_to_arrays(self, params_list):
         """List of structured param dicts -> dict of host numpy arrays
         (device-ready: jnp.asarray is a cheap upload when a jitted consumer

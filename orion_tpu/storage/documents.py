@@ -241,16 +241,19 @@ def apply_update(doc, update):
         node[parts[-1]] = _copy_doc(value)
     for key in unsets:
         parts = key.split(".")
+        # Read-only probe first: an absent final key must stay an
+        # allocation-free no-op — the COW walk below copies every dict on
+        # the path, which would manufacture garbage for a no-op update.
+        probe = new_doc
+        for part in parts[:-1]:
+            probe = probe.get(part) if isinstance(probe, dict) else None
+        if not isinstance(probe, dict) or parts[-1] not in probe:
+            continue
         node = new_doc
         for part in parts[:-1]:
-            child = node.get(part)
-            if not isinstance(child, dict):
-                node = None
-                break
-            node[part] = dict(child)
+            node[part] = dict(node[part])
             node = node[part]
-        if isinstance(node, dict):
-            node.pop(parts[-1], None)
+        node.pop(parts[-1], None)
     return new_doc
 
 

@@ -701,3 +701,33 @@ def test_naive_copy_share_tuples_union_over_mro():
     assert clone._bracket_of == algo._bracket_of
     clone._bracket_of["sentinel"] = 0
     assert "sentinel" not in algo._bracket_of
+
+
+def test_de_set_state_adopts_restored_popsize():
+    """Resuming a state saved under a smaller popsize must shrink popsize to
+    the restored arrays (ADVICE r5): the seeding phase writes at
+    _pop[_n_filled] and would IndexError past a smaller restored
+    population."""
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    small = create_algo(space, {"de": {"popsize": 6}}, seed=1)
+    params = small.suggest(4)
+    small.observe(params, [{"objective": float(i)} for i in range(4)])
+    state = small.state_dict()
+
+    big = create_algo(space, {"de": {"popsize": 32}}, seed=1)
+    big.set_state(state)
+    assert big.popsize == 6
+    # Seeding continues past the old boundary without indexing past _pop.
+    more = big.suggest(4)
+    big.observe(more, [{"objective": 0.1 * i} for i in range(4)])
+    assert big._n_filled == 6  # filled exactly; surplus went through crowding
+
+
+def test_de_set_state_shape_mismatch_raises():
+    space = build_space({"a": "uniform(0, 1)", "b": "uniform(0, 1)"})
+    algo = create_algo(space, {"de": {"popsize": 8}}, seed=0)
+    state = algo.state_dict()
+    state["fit"] = state["fit"][:-1]  # corrupt: 8 pop rows, 7 fitness values
+    fresh = create_algo(space, {"de": {"popsize": 8}}, seed=0)
+    with pytest.raises(ValueError, match="inconsistent DE state"):
+        fresh.set_state(state)

@@ -253,3 +253,25 @@ def test_asha_bo_beats_plain_asha_on_ackley():
         for s in seeds
     ])
     assert asha_bo < asha, (asha_bo, asha)
+
+
+def test_point_hash_never_compares_values(asha):
+    """_point_hash sorts items by KEY only (ADVICE r5): values must never be
+    compared, so heterogeneous/non-orderable values cannot make sorted()
+    raise TypeError."""
+
+    class Poison:
+        """Raises on ANY ordering/equality comparison."""
+
+        def __lt__(self, other):
+            raise AssertionError("param value was compared")
+
+        __gt__ = __le__ = __ge__ = __eq__ = __lt__
+
+        def __repr__(self):
+            return "Poison()"
+
+    params = {"x": Poison(), "a": Poison(), "z": (1, "mixed"), "epochs": 1}
+    h1 = asha._point_hash(params)
+    h2 = asha._point_hash(dict(reversed(list(params.items()))))
+    assert h1 == h2  # key-sorted: insertion order irrelevant

@@ -1,0 +1,362 @@
+"""Device-resident history + columnar boundary regression tests.
+
+Two guarantees pinned here (ISSUE 1 tentpole):
+
+1. **Bit-equality of the incremental device-buffer path** against the full
+   host re-pad/re-upload path (`run_suggest_step`), including across a
+   pow-2 growth boundary (64 -> 65 observations).  The incremental path is
+   only a transport optimization — if a single bit drifts, the optimization
+   has silently changed the optimizer.
+
+2. **Columnar-vs-dict observe equivalence**: feeding pre-encoded
+   ``params_to_cube`` rows through ``observe(cube=...)`` (what the producer
+   does) must leave the algorithm in exactly the state the per-dict encode
+   path produces, and a producer round-trip must register identical trials
+   either way.
+"""
+
+import copy
+
+import jax
+import numpy as np
+import pytest
+
+from orion_tpu.algo.base import create_algo
+from orion_tpu.algo.history import DeviceHistory, _next_pow2
+from orion_tpu.algo.tpu_bo import copula_transform, run_suggest_step
+from orion_tpu.core.experiment import build_experiment
+from orion_tpu.core.producer import Producer
+from orion_tpu.core.trial import Result
+from orion_tpu.space.dsl import build_space
+from orion_tpu.storage import create_storage
+
+D = 3
+_CFG = {"n_init": 8, "n_candidates": 128, "fit_steps": 3}
+
+
+def _space():
+    return build_space({f"x{i}": "uniform(0, 1)" for i in range(D)})
+
+
+def _obs(algo, X, scale=1.0):
+    params = [{f"x{i}": float(r[i]) for i in range(D)} for r in np.asarray(X)]
+    algo.observe(
+        params,
+        [{"objective": float(scale * np.sum(np.asarray(r) ** 2))} for r in X],
+    )
+
+
+def _reupload_rows(algo, num, key):
+    """The full host re-pad/re-upload reference path, replicating exactly
+    what `_suggest_cube`'s device-resident branch feeds the fused jit."""
+    n = algo._x.shape[0]
+    center = (
+        algo._tr_center
+        if algo._tr_center is not None and algo._tr_center < n
+        else int(np.argmin(algo._y))
+    )
+    y_fit = (
+        copula_transform(algo._y)
+        if algo.y_transform == "copula"
+        else algo._y
+    )
+    rows, _ = run_suggest_step(
+        key,
+        algo._x,
+        y_fit,
+        algo._x[center],
+        algo._gp_state,
+        num,
+        n_candidates=algo.n_candidates,
+        kernel=algo.kernel,
+        acq=algo.acq,
+        fit_steps=algo.fit_steps,
+        refit_steps=algo.refit_steps,
+        local_frac=algo.local_frac,
+        local_sigma=algo.local_sigma,
+        beta=algo.beta,
+        trust_region=algo.trust_region,
+        tr_length=algo._tr_length,
+        tr_perturb_dims=algo.tr_perturb_dims,
+        mesh=None,
+    )
+    return np.asarray(rows)
+
+
+def test_incremental_buffer_bit_equal_across_pow2_growth():
+    """Incremental device appends must yield suggestions bit-identical to
+    the re-upload path at n=64 (cap boundary) AND n=65 (after the 64->128
+    re-pad)."""
+    algo = create_algo(_space(), {"tpu_bo": dict(_CFG)}, seed=11)
+    rng = np.random.default_rng(5)
+    for _ in range(8):  # 8 batches of 8 -> n=64, the pad boundary
+        _obs(algo, rng.uniform(size=(8, D)).astype(np.float32))
+    assert algo._hist.count == 64 and algo._hist.fit_view()[3] == 64
+
+    for n_extra in (0, 1):  # compare at n=64, then cross to n=65
+        if n_extra:
+            _obs(algo, rng.uniform(size=(1, D)).astype(np.float32))
+            assert algo._hist.count == 65
+            assert algo._hist.fit_view()[3] == 128  # re-padded bucket
+        expected_key = jax.random.split(algo.rng_key)[1]
+        ref = _reupload_rows(algo, 16, expected_key)
+        out = np.asarray(algo._suggest_cube(16))
+        assert np.array_equal(out, ref), (
+            f"incremental path diverged from re-upload at n={64 + n_extra}"
+        )
+
+
+def test_device_history_zero_padding_invariant():
+    hist = DeviceHistory(2, floor=16)
+    rng = np.random.default_rng(0)
+    total = 0
+    for b in (5, 16, 3, 20):  # uneven batches, forces bucketing + growth
+        hist.append(rng.uniform(size=(b, 2)), rng.normal(size=b))
+        total += b
+        x, y, mask, m = hist.fit_view()
+        assert m == _next_pow2(total, floor=16)
+        x, y, mask = np.asarray(x), np.asarray(y), np.asarray(mask)
+        assert x.shape == (m, 2)
+        assert np.all(mask[:total] == 1.0)
+        assert np.all(mask[total:] == 0.0)
+        assert np.all(x[total:] == 0.0) and np.all(y[total:] == 0.0)
+
+
+def test_device_history_clone_copy_on_write():
+    """A deepcopied history (the producer's naive copy) shares buffers until
+    either side appends; appends on one side never leak into the other."""
+    hist = DeviceHistory(2, floor=16)
+    hist.append(np.ones((4, 2)), np.ones(4))
+    clone = copy.deepcopy(hist)
+    assert clone._x is hist._x  # shared until a write
+    clone.append(2 * np.ones((3, 2)), 2 * np.ones(3))
+    assert clone.count == 7 and hist.count == 4
+    # Original's view is untouched past its own count.
+    x, _, mask, _ = hist.fit_view()
+    assert np.all(np.asarray(mask)[4:] == 0.0)
+    assert np.all(np.asarray(x)[4:] == 0.0)
+    # And the original may keep appending independently afterwards.
+    hist.append(3 * np.ones((2, 2)), 3 * np.ones(2))
+    assert hist.count == 6
+    assert np.all(np.asarray(clone.fit_view()[0])[4:7] == 2.0)
+
+
+def test_columnar_observe_equals_dict_observe():
+    """observe(cube=params_to_cube(params)) must leave tpu_bo in the exact
+    state the dict path produces — host mirrors AND device buffers."""
+    space = _space()
+    a = create_algo(space, {"tpu_bo": dict(_CFG)}, seed=3)
+    b = create_algo(space, {"tpu_bo": dict(_CFG)}, seed=3)
+    rng = np.random.default_rng(1)
+    X = rng.uniform(size=(20, D)).astype(np.float32)
+    params = [{f"x{i}": float(r[i]) for i in range(D)} for r in X]
+    results = [{"objective": float(np.sum(r**2))} for r in X]
+    a.observe(params, results)
+    b.observe(params, results, cube=space.params_to_cube(params))
+    assert np.array_equal(a._x, b._x) and np.array_equal(a._y, b._y)
+    assert np.array_equal(
+        np.asarray(a._hist.fit_view()[0]), np.asarray(b._hist.fit_view()[0])
+    )
+    # Same state -> same next suggestion (same seed, same rng position).
+    assert np.array_equal(
+        np.asarray(a._suggest_cube(8)), np.asarray(b._suggest_cube(8))
+    )
+
+
+def test_observe_cube_row_mismatch_raises():
+    space = _space()
+    algo = create_algo(space, {"tpu_bo": dict(_CFG)}, seed=0)
+    params = [{f"x{i}": 0.5 for i in range(D)}]
+    with pytest.raises(ValueError, match="rows"):
+        algo.observe(
+            params,
+            [{"objective": 1.0}],
+            cube=np.zeros((2, D), dtype=np.float32),
+        )
+
+
+def _run_producer_rounds(rounds=3, pool=6, seed=3, dict_path=False,
+                         monkeypatch=None):
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage,
+        "columnar-eq",
+        priors={"x": "uniform(0, 1)", "y": "uniform(0, 1)"},
+        max_trials=200,
+        algorithms={"tpu_bo": {"n_init": 4, "n_candidates": 64, "fit_steps": 2}},
+        strategy="MaxParallelStrategy",
+        pool_size=pool,
+    ).instantiate(seed=seed)
+    producer = Producer(exp)
+    if dict_path:
+        # Disable the columnar cache: observe falls back to the per-dict
+        # encode path.  The two runs must be indistinguishable.
+        monkeypatch.setattr(
+            Producer, "_cube_rows_for", lambda self, trials: None
+        )
+    batches = []
+    for _ in range(rounds):
+        producer.update()
+        producer.produce(pool)
+        new = [t for t in exp.fetch_trials() if t.status == "new"]
+        batches.append(sorted(tuple(sorted(t.params.items())) for t in new))
+        # Complete half, leave half in flight: exercises BOTH columnar
+        # feeds (completed -> real algo, lies -> naive copy) every round.
+        for i, trial in enumerate(sorted(new, key=lambda t: t.id)):
+            storage.set_trial_status(trial, "reserved", was="new")
+            if i % 2 == 0:
+                storage.update_completed_trial(
+                    trial,
+                    [Result("obj", "objective",
+                            trial.params["x"] * 1.7 + trial.params["y"])],
+                )
+    return batches
+
+
+def test_producer_columnar_vs_dict_roundtrip_equivalence(monkeypatch):
+    """Full producer rounds (suggest -> register -> lies -> observe) must
+    register bit-identical trials with the columnar fast path on or off."""
+    columnar = _run_producer_rounds()
+    with monkeypatch.context() as m:
+        dict_based = _run_producer_rounds(dict_path=True, monkeypatch=m)
+    assert columnar == dict_based
+
+
+def test_producer_cube_cache_rows_match_codec(monkeypatch):
+    """Cached rows must be exactly Space.params_to_cube of the trial params
+    (the equivalence contract), and completed trials must be evicted."""
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage,
+        "cache-contract",
+        priors={"x": "uniform(0, 1)", "y": "uniform(0, 1)"},
+        max_trials=50,
+        algorithms={"tpu_bo": {"n_init": 4, "n_candidates": 64, "fit_steps": 2}},
+        strategy="MaxParallelStrategy",
+        pool_size=4,
+    ).instantiate(seed=9)
+    producer = Producer(exp)
+    producer.update()
+    producer.produce(4)
+    trials = sorted(exp.fetch_trials(), key=lambda t: t.id)
+    space = exp.algorithm.space
+    # One completion first: constant-liar strategies need an observed
+    # objective before they can lie for the in-flight rest.
+    done, in_flight = trials[0], trials[1:]
+    for t in trials:
+        storage.set_trial_status(t, "reserved", was="new")
+    storage.update_completed_trial(
+        done, [Result("obj", "objective", float(done.params["x"]))]
+    )
+    producer.update()  # observes `done`, lies for `in_flight` -> rows cached
+    for t in in_flight:
+        row = producer._cube_cache.get(t.id)
+        assert row is not None
+        assert np.array_equal(row, space.params_to_cube([t.params])[0])
+    # Completed trials are evicted once the real algorithm observed them.
+    assert done.id not in producer._cube_cache
+    for t in in_flight:
+        storage.update_completed_trial(
+            t, [Result("obj", "objective", float(t.params["x"]))]
+        )
+    producer.update()
+    for t in in_flight:
+        assert t.id not in producer._cube_cache
+
+
+def test_subclass_super_suggest_does_not_recurse():
+    """A subclass override of suggest() that delegates to super().suggest()
+    (a valid pre-columnar plugin pattern) must not recurse through
+    suggest_batch's override routing."""
+    from orion_tpu.algo.random_search import RandomSearch
+
+    calls = []
+
+    class PostFiltering(RandomSearch):
+        def suggest(self, num=1):
+            calls.append(num)
+            return super().suggest(num)
+
+    algo = PostFiltering(_space(), seed=0)
+    assert len(algo.suggest(3)) == 3
+    batch = algo.suggest_batch(2)  # routed through the override -> no cube
+    assert batch.cube is None and len(batch.params) == 2
+    assert calls == [3, 2]  # once per call, not once per recursion level
+
+
+def test_finalize_suggest_override_is_routed_and_does_not_recurse():
+    """finalize_suggest_batch must route through a plugin's
+    finalize_suggest override (post-processing must run), and the base
+    finalize_suggest must be reachable via super() without recursion."""
+    from orion_tpu.algo.random_search import RandomSearch
+
+    class PostFinalize(RandomSearch):
+        finalized = 0
+
+        def finalize_suggest(self, handle):
+            type(self).finalized += 1
+            return super().finalize_suggest(handle)
+
+    algo = PostFinalize(_space(), seed=0)
+    handle = algo.dispatch_suggest(2)
+    batch = algo.finalize_suggest_batch(handle)
+    assert PostFinalize.finalized == 1
+    assert len(batch.params) == 2 and batch.cube is None
+
+
+def test_dict_keyed_algorithms_skip_cube_build():
+    """uses_observe_cube=False (plain ASHA) must disable the producer's
+    cube encode/cache entirely — the rows would be thrown away."""
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage,
+        "asha-no-cube",
+        priors={"x": "uniform(0, 1)", "epochs": "fidelity(1, 9, 3)"},
+        max_trials=50,
+        algorithms={"asha": {}},
+        strategy="MaxParallelStrategy",
+        pool_size=4,
+    ).instantiate(seed=1)
+    producer = Producer(exp)
+    assert producer._observe_takes_cube is False
+    producer.update()
+    producer.produce(4)
+    trials = exp.fetch_trials()
+    for t in trials:
+        storage.set_trial_status(t, "reserved", was="new")
+    storage.update_completed_trial(
+        trials[0], [Result("obj", "objective", 1.0)]
+    )
+    producer.update()
+    assert producer._cube_cache == {}
+
+
+def test_cube_cache_evicts_broken_trials():
+    """Rows cached for in-flight trials that terminate WITHOUT an
+    objective (broken) must be swept, or the cache grows one row per
+    failed trial forever."""
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage,
+        "cache-sweep",
+        priors={"x": "uniform(0, 1)", "y": "uniform(0, 1)"},
+        max_trials=50,
+        algorithms={"tpu_bo": {"n_init": 4, "n_candidates": 64, "fit_steps": 2}},
+        strategy="MaxParallelStrategy",
+        pool_size=4,
+    ).instantiate(seed=2)
+    producer = Producer(exp)
+    producer.update()
+    producer.produce(4)
+    trials = sorted(exp.fetch_trials(), key=lambda t: t.id)
+    for t in trials:
+        storage.set_trial_status(t, "reserved", was="new")
+    storage.update_completed_trial(
+        trials[0], [Result("obj", "objective", 0.5)]
+    )
+    producer.update()  # lies cache rows for the 3 in-flight trials
+    broken = trials[1]
+    assert broken.id in producer._cube_cache
+    storage.set_trial_status(broken, "broken", was="reserved")
+    producer.update()
+    assert broken.id not in producer._cube_cache

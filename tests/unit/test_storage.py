@@ -990,3 +990,19 @@ def test_reservation_stamps_worker_identity(storage):
     storage.register_trial(trial)
     reserved = storage.reserve_trial("e1")
     assert reserved.worker == f"{socket.gethostname()}:{os.getpid()}"
+
+
+def test_unset_absent_key_is_allocation_free_noop():
+    """$unset of an absent (possibly nested) key must not copy dicts along
+    the path (ADVICE r5): the returned doc shares the untouched subtrees."""
+    from orion_tpu.storage.documents import apply_update
+
+    doc = {"a": {"b": 1}, "c": 2}
+    out = apply_update(doc, {"$unset": {"a.missing": 1, "missing.x": 1}})
+    assert out["a"] is doc["a"]  # no COW copy for a no-op
+    assert out == doc
+
+    # A present key is still removed, copy-on-write (original untouched).
+    out2 = apply_update(doc, {"$unset": {"a.b": 1}})
+    assert out2 == {"a": {}, "c": 2}
+    assert doc["a"] == {"b": 1}
