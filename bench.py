@@ -46,7 +46,7 @@ def _hartmann6_np(u):
     return np.asarray(f.hartmann6(jnp.asarray(u)))
 
 
-def _make_algo(seed=SEED):
+def _make_algo(seed=SEED, n_candidates=16384, fit_steps=40):
     from orion_tpu.algo.base import create_algo
     from orion_tpu.space.dsl import build_space
 
@@ -55,8 +55,8 @@ def _make_algo(seed=SEED):
         space,
         # local_frac 0.3 = the measured setting for smooth multimodal
         # landscapes (runner.py's hartmann6 preset comment has the A/B).
-        {"tpu_bo": {"n_init": N_INIT, "n_candidates": 16384, "fit_steps": 40,
-                     "local_frac": 0.3}},
+        {"tpu_bo": {"n_init": N_INIT, "n_candidates": n_candidates,
+                     "fit_steps": fit_steps, "local_frac": 0.3}},
         seed=seed,
     )
 
@@ -163,7 +163,72 @@ def run_anchor_regret(X0, y0):
     return float(y.min()) - GLOBAL_MIN, times
 
 
-def bench_breakdown(rounds=4):
+def bench_storage(q=Q, rounds=3):
+    """The storage edge of one producer round: register a q-trial batch
+    through ``DocumentStorage.register_trials`` on the two backends that
+    matter at scale — sqlite (the durable local default) and network (an
+    in-process loopback server) — measuring wall ms per round AND the
+    backend-level operation count (SQLite transactions / wire round
+    trips).  The batched write path commits the whole round as ONE
+    transaction / ONE wire request, so ``storage_ops_per_round`` must stay
+    O(1) regardless of q; a regression back to per-trial commits shows up
+    here as q, not 1.
+
+    Returns ``(storage_ms, storage_ops_per_round)`` dicts keyed by
+    backend."""
+    import os
+    import tempfile
+
+    from orion_tpu.core.trial import Trial
+    from orion_tpu.storage.base import DocumentStorage
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    rng = np.random.default_rng(SEED + 3)
+    storage_ms, storage_ops = {}, {}
+
+    def _run(name, db, ops_counter):
+        storage = DocumentStorage(db)
+        exp = storage.create_experiment(
+            {"name": "bench-storage", "metadata": {"user": "bench"}}
+        )
+        times = []
+        ops_before = ops_counter()
+        for _ in range(rounds):
+            trials = [
+                Trial(
+                    experiment=exp["_id"],
+                    params={f"x{i}": float(v) for i, v in enumerate(row)},
+                )
+                for row in rng.uniform(size=(q, 6))
+            ]
+            t0 = time.perf_counter()
+            outcomes = storage.register_trials(trials)
+            times.append(time.perf_counter() - t0)
+            assert not any(isinstance(o, Exception) for o in outcomes)
+        storage_ms[name] = round(1e3 * float(np.median(times)), 3)
+        storage_ops[name] = int(round((ops_counter() - ops_before) / rounds))
+
+    with tempfile.TemporaryDirectory(prefix="orion-bench-storage-") as tmpdir:
+        sqlite_db = SQLiteDB(os.path.join(tmpdir, "bench.sqlite"))
+        try:
+            _run("sqlite", sqlite_db, lambda: sqlite_db.txn_count)
+        finally:
+            sqlite_db.close()
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    net_db = NetworkDB(host=host, port=port)
+    try:
+        _run("network", net_db, lambda: net_db.wire_requests)
+    finally:
+        net_db._close()
+        server.shutdown()
+        server.server_close()
+    return storage_ms, storage_ops
+
+
+def bench_breakdown(rounds=4, q=Q, algo=None, n_hist=130):
     """Median per-round host/device breakdown of the q=1024 boundary at the
     steady-state shape, one stage at a time (the stages algo.observe +
     algo.suggest run internally, replayed through the same public codec and
@@ -181,13 +246,17 @@ def bench_breakdown(rounds=4):
     - dict_build:    per-dim arrays -> q param dicts (arrays_to_params)
 
     Everything except wait_transfer is host boundary tax; regressions in
-    any stage show up in the JSON line."""
+    any stage show up in the JSON line.  ``storage_ms`` (the sqlite commit
+    of one q-batch registration, measured by :func:`bench_storage`) is
+    merged into this dict by ``main`` — the host stage the pipelined
+    producer commit overlaps with the next round's dispatch."""
     rng = np.random.default_rng(SEED + 2)
-    algo = _make_algo(seed=SEED + 2)
+    if algo is None:
+        algo = _make_algo(seed=SEED + 2)
     space = algo.space
-    X = rng.uniform(size=(130, 6)).astype(np.float32)
+    X = rng.uniform(size=(n_hist, 6)).astype(np.float32)
     _observe(algo, X, _hartmann6_np(X))
-    algo.suggest(Q)  # compile
+    algo.suggest(q)  # compile
 
     stages = {k: [] for k in
               ("encode", "upload", "dispatch", "wait_transfer", "decode",
@@ -201,7 +270,7 @@ def bench_breakdown(rounds=4):
         t1 = time.perf_counter()
         algo.observe(params, [{"objective": float(v)} for v in yn], cube=cube)
         t2 = time.perf_counter()
-        rows = algo._suggest_cube(Q)
+        rows = algo._suggest_cube(q)
         t3 = time.perf_counter()
         out = np.asarray(rows)
         t4 = time.perf_counter()
@@ -226,10 +295,59 @@ def bench_device_decomposition():
     return device_seconds("hartmann6-q1024", reps=5, k_hi=9) * 1e3
 
 
-def main():
+def _json_payload(
+    metric,
+    value,
+    vs_baseline,
+    regret,
+    anchor_regret,
+    wall_ms_per_round,
+    device_ms_per_round,
+    breakdown_ms,
+    storage_ms,
+    storage_ops_per_round,
+    smoke=False,
+):
+    """THE output schema — built here for both the full run and --smoke, so
+    the smoke test's key assertions actually cover what the full bench
+    emits (two hand-built dicts would let drift ship silently)."""
+    payload = {
+        "metric": metric,
+        "value": value,
+        "unit": "suggestions/sec",
+        "vs_baseline": vs_baseline,
+        "regret": regret,
+        "anchor_regret": anchor_regret,
+        # Decomposition of one q=1024 round (docs/performance.md):
+        # wall = device compute + this image's host<->device tunnel
+        # round trip + host-side transform/decode.
+        "wall_ms_per_round": wall_ms_per_round,
+        "device_ms_per_round": device_ms_per_round,
+        # Per-stage host/device split of one steady-state round
+        # (bench_breakdown docstring): everything except wait_transfer is
+        # host boundary tax; storage_ms is the stage the pipelined
+        # producer commit overlaps with device dispatch.
+        "breakdown_ms": breakdown_ms,
+        # The storage edge per backend (bench_storage): wall ms of one
+        # q-batch registration, and how many backend-level operations
+        # (transactions / wire round trips) it cost.  The batched write
+        # path keeps ops O(1) regardless of q.
+        "storage_ms": storage_ms,
+        "storage_ops_per_round": storage_ops_per_round,
+    }
+    if smoke:
+        payload["smoke"] = True
+    return payload
+
+
+def main(smoke=False):
+    if smoke:
+        return main_smoke()
     ours_sps = bench_throughput()
     breakdown = bench_breakdown()
     device_ms = bench_device_decomposition()
+    storage_ms, storage_ops = bench_storage()
+    breakdown["storage_ms"] = storage_ms["sqlite"]
 
     rng = np.random.default_rng(SEED)
     X0 = rng.uniform(size=(N_INIT, 6)).astype(np.float32)
@@ -244,29 +362,60 @@ def main():
     )
     print(
         json.dumps(
-            {
-                "metric": (
+            _json_payload(
+                metric=(
                     "suggestions/sec @ q=1024, Hartmann6 "
                     "(public suggest/observe, refit per round)"
                 ),
-                "value": round(ours_sps, 2),
-                "unit": "suggestions/sec",
-                "vs_baseline": round(ours_sps / anchor_sps, 2),
-                "regret": round(ours_regret, 6),
-                "anchor_regret": round(anchor_regret, 6),
-                # Decomposition of one q=1024 round (docs/performance.md):
-                # wall = device compute + this image's host<->device tunnel
-                # round trip + host-side transform/decode.
-                "wall_ms_per_round": round(1e3 * Q / ours_sps, 2),
-                "device_ms_per_round": round(device_ms, 2),
-                # Per-stage host/device split of one steady-state round
-                # (bench_breakdown docstring): everything except
-                # wait_transfer is host boundary tax.
-                "breakdown_ms": breakdown,
-            }
+                value=round(ours_sps, 2),
+                vs_baseline=round(ours_sps / anchor_sps, 2),
+                regret=round(ours_regret, 6),
+                anchor_regret=round(anchor_regret, 6),
+                wall_ms_per_round=round(1e3 * Q / ours_sps, 2),
+                device_ms_per_round=round(device_ms, 2),
+                breakdown_ms=breakdown,
+                storage_ms=storage_ms,
+                storage_ops_per_round=storage_ops,
+            )
+        )
+    )
+
+
+def main_smoke():
+    """Tiny-n schema smoke: the same JSON line shape in seconds instead of
+    minutes — no regret parity, no sklearn anchor, no device
+    decomposition.  The tier-1 bench smoke test runs ``bench.py --smoke``
+    and asserts the breakdown/storage keys, so schema drift (a renamed
+    stage, a dropped counter) is caught by the unit suite instead of the
+    next full bench run."""
+    q = 32
+    algo = _make_algo(seed=SEED + 2, n_candidates=512, fit_steps=8)
+    breakdown = bench_breakdown(rounds=1, q=q, algo=algo, n_hist=20)
+    storage_ms, storage_ops = bench_storage(q=64, rounds=1)
+    breakdown["storage_ms"] = storage_ms["sqlite"]
+    print(
+        json.dumps(
+            _json_payload(
+                metric=(
+                    f"SMOKE (q={q}): schema check only — run without "
+                    "--smoke for the headline numbers"
+                ),
+                value=None,
+                vs_baseline=None,
+                regret=None,
+                anchor_regret=None,
+                wall_ms_per_round=None,
+                device_ms_per_round=None,
+                breakdown_ms=breakdown,
+                storage_ms=storage_ms,
+                storage_ops_per_round=storage_ops,
+                smoke=True,
+            )
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(smoke="--smoke" in sys.argv[1:])

@@ -72,11 +72,12 @@ def format_info(experiment):
 
 
 def _perf_section(experiment):
-    """suggest/observe latency percentiles from producer telemetry
+    """suggest/observe/register latency percentiles from producer telemetry
     (SURVEY §5: timing hooks are a TPU-build addition; no reference
-    counterpart)."""
+    counterpart).  ``register`` is the batched storage commit of a produce
+    round — the stage the pipelined commit overlaps with device dispatch."""
     lines = []
-    for op in ("suggest", "observe"):
+    for op in ("suggest", "observe", "register"):
         try:
             docs = experiment.storage.fetch_timings(experiment, op=op)
         except Exception:

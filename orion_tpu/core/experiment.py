@@ -146,15 +146,26 @@ class Experiment:
         self._storage.register_trial(trial)
         return trial
 
-    def register_trials(self, trials, parents=()):
-        """Batch registration; returns per-trial outcomes (the trial, or its
-        DuplicateKeyError) — one pipelined round trip on the network
-        backend."""
+    def prepare_trials(self, trials, parents=()):
+        """Stamp the identity fields (experiment, lineage parents, submit
+        time) WITHOUT writing storage.  This finalizes each trial's id
+        (the md5 covers experiment + params), so a caller may key caches
+        or dispatch device work against the real ids BEFORE the storage
+        commit — the producer's pipelined commit path does exactly that."""
         now = time.time()
         for trial in trials:
             trial.experiment = self._id
             trial.parents = list(parents)
             trial.submit_time = now
+        return trials
+
+    def register_trials(self, trials, parents=(), prepared=False):
+        """Batch registration; returns per-trial outcomes (the trial, or its
+        DuplicateKeyError) — one storage round (single transaction / wire
+        request on capable backends).  ``prepared=True`` skips re-stamping
+        trials already passed through :meth:`prepare_trials`."""
+        if not prepared:
+            self.prepare_trials(trials, parents)
         return self._storage.register_trials(trials)
 
     def register_lie(self, trial):

@@ -84,6 +84,13 @@ class Producer:
         # Speculative next-round suggestion: (handle, algo) dispatched at the
         # end of produce() so the device round trip overlaps trial execution.
         self._speculative = None
+        # Trial ids already conditioned (register_suggestion + lie) onto the
+        # CURRENT naive copy by _dispatch_speculative: the pipelined commit
+        # may re-invoke it on the same instance (mid-loop dispatch opted
+        # out, post-loop retry), and re-observing the same lies would skew
+        # opt-in model-based speculation.  Reset whenever the naive copy is
+        # rebuilt.
+        self._spec_conditioned = set()
         # Probe the EVC family ONCE: walking the tree costs extra collection
         # scans per round (each a full lock/unpickle on the file backend),
         # which an un-branched experiment should never pay.  A branch
@@ -214,7 +221,13 @@ class Producer:
         """Naive algo = deepcopy of real + lies for in-flight trials
         (reference `producer.py:159-174`)."""
         self.naive_algorithm = copy.deepcopy(self.algorithm)
+        self._spec_conditioned.clear()  # fresh copy: nothing conditioned yet
         lying = self._produce_lies(incomplete)
+        # The lies observed right below ARE conditioning: seed the set with
+        # their source ids, or a mid-round backoff (rebuild here, then the
+        # next iteration's speculative dispatch) would observe the same
+        # in-flight trials' lies a second time on this very copy.
+        self._spec_conditioned.update(src.id for src, _ in lying)
         if lying:
             params = [lt.params for _, lt in lying]
             results = [{"objective": lt.lie.value} for _, lt in lying]
@@ -254,6 +267,12 @@ class Producer:
     # --- production ---------------------------------------------------------
     def produce(self, pool_size=None, own_in_flight=0):
         """Register `pool_size` new trials (reference `producer.py:69-101`).
+
+        The round's storage commit is PIPELINED: once the final batch is
+        built, the next round's device suggest is dispatched first and the
+        batched register (one transaction / one wire request) runs while
+        that computation is in flight — storage latency and device latency
+        overlap instead of adding up.
 
         ``own_in_flight``: how many of the experiment's reserved trials THE
         CALLER itself is holding.  An opt-out normally backs off while
@@ -330,12 +349,43 @@ class Producer:
                 Trial(params=params)
                 for params in suggested[: pool_size - registered]
             ]
-            # Batch registration: ONE pipelined round trip on the network
-            # backend (q=4096 would otherwise pay q serialized RTTs); per-
-            # trial DuplicateKeyError comes back as that slot's outcome.
-            outcomes = self.experiment.register_trials(
-                batch, parents=self._leaf_ids
-            )
+            # Pipelined commit (the storage twin of speculative suggest):
+            # when this batch fills the round, stamp identities now —
+            # freezing ids, so the speculative lie path and cube cache key
+            # correctly — dispatch the NEXT round's device suggest, and
+            # only then write storage, so the commit overlaps jax async
+            # dispatch instead of serializing with it.  Presuming the
+            # batch registers is safe: a slot that turns out duplicate IS
+            # durably registered (by whoever won the race), so the
+            # speculative conditioning stays truthful; the handle is
+            # discarded below if any slot fails to register.
+            prepared = registered + len(batch) >= pool_size
+            overlapped = False
+            if prepared:
+                self.experiment.prepare_trials(batch, parents=self._leaf_ids)
+                for trial in batch:
+                    trial._id_override = trial.id
+                overlapped = self._dispatch_speculative(
+                    pool_size, registered_trials + batch
+                )
+            # Batch registration: ONE storage round — a single transaction
+            # on SQL backends, one wire request on the network driver
+            # (q=4096 would otherwise pay q serialized RTTs); per-trial
+            # DuplicateKeyError comes back as that slot's outcome.
+            t0 = time.perf_counter()
+            try:
+                outcomes = self.experiment.register_trials(
+                    batch, parents=self._leaf_ids, prepared=prepared
+                )
+            except Exception:
+                if overlapped:
+                    # Transport-level commit failure (no per-slot outcomes):
+                    # the batch's fate is unknown, so the handle conditioned
+                    # on it must go — same contract as the per-slot discard
+                    # below.
+                    self._speculative = None
+                raise
+            self._record_timing("register", time.perf_counter() - t0, len(batch))
             had_duplicate = False
             batch_error = None
             for trial, outcome in zip(batch, outcomes):
@@ -362,12 +412,18 @@ class Producer:
                     # md5 the columnar cache exists to avoid.
                     trial._id_override = trial.id
                     registered_trials.append(trial)
+            if overlapped and (had_duplicate or batch_error is not None):
+                # The speculative copy was conditioned on slots that did
+                # not register; drop the handle — the post-loop dispatch
+                # (or the next round's) redoes it from the true set.
+                self._speculative = None
             if batch_error is not None:
                 raise batch_error
             if had_duplicate:
                 self.backoff()
         self._flush_timings()
-        self._dispatch_speculative(pool_size, registered_trials)
+        if self._speculative is None:
+            self._dispatch_speculative(pool_size, registered_trials)
         return registered
 
     # --- speculative overlap ------------------------------------------------
@@ -384,22 +440,37 @@ class Producer:
         batch so the speculative batch is conditioned like an async
         worker's round would be, not drawn from the identical posterior.
         jax's async dispatch runs the computation and transfer while the
-        host executes trials; the next produce() call picks up the result."""
+        host executes trials; the next produce() call picks up the result.
+
+        Returns True when a handle was actually dispatched — the pipelined
+        commit path uses this to know the storage write it is about to
+        issue overlaps live device work."""
         self._speculative = None
         algo = self.naive_algorithm
         if algo is None or not getattr(algo, "speculation_safe", False):
-            return
+            return False
         try:
-            if registered_trials:
+            # Condition each trial onto this naive copy AT MOST ONCE: the
+            # pipelined commit may re-invoke this on the same instance
+            # (mid-loop dispatch opted out, post-loop retry), and
+            # re-observing the same lies would double-count fantasies for
+            # opt-in model-based speculation.  The set resets with every
+            # naive rebuild (_update_naive_algorithm).
+            fresh = [
+                t for t in registered_trials
+                if t.id not in self._spec_conditioned
+            ]
+            if fresh:
                 # The dispatch copy predates this round's registrations (it
                 # was deepcopied in update()): mark the just-registered
                 # points consumed on IT too, or cursor-based algorithms
                 # (grid) would speculatively re-suggest the exact batch just
                 # written and pay a round of DuplicateKeyError + backoff.
-                for trial in registered_trials:
+                for trial in fresh:
                     algo.register_suggestion(trial.params)
+                    self._spec_conditioned.add(trial.id)
                 lie_trials, lie_results = [], []
-                for trial in registered_trials:
+                for trial in fresh:
                     lie = self.strategy.lie(trial)
                     if lie is not None and lie.value is not None:
                         lie_trials.append(trial)
@@ -414,13 +485,14 @@ class Producer:
             handle = algo.dispatch_suggest(pool_size)
         except Exception:  # pragma: no cover - speculation must never break a run
             log.debug("speculative dispatch failed", exc_info=True)
-            return
+            return False
         if handle is None:
-            return
+            return False
         # Keep the real algo's rng stream ahead of the speculative draw, or
         # the next naive copy would replay the same key and duplicate it.
         self.algorithm.rng_key = algo.rng_key
         self._speculative = (handle, algo)
+        return True
 
     def _take_speculative(self, pool_size):
         spec, self._speculative = self._speculative, None

@@ -126,6 +126,17 @@ class PickledDB:
         with self._locked() as db:
             return db.update_many(collection, pairs)
 
+    def apply_batch(self, ops):
+        """The whole batch in ONE lock/load/dump cycle (see
+        MemoryDB.apply_batch for the outcome contract).  A q-batch
+        registration otherwise pays q full unpickle+rewrite cycles — the
+        dominant cost of this backend.  Successful slots persist even when
+        a later slot fails (matching the sequential path: MemoryDB's
+        insert checks uniqueness before mutating, so a failed slot leaves
+        no partial state in the dumped snapshot)."""
+        with self._locked() as db:
+            return db.apply_batch(ops)
+
     def read(self, collection, query=None, projection=None):
         with self._locked(write=False) as db:
             return db.read(collection, query, projection)

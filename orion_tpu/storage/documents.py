@@ -14,7 +14,7 @@ import copy
 import json
 import threading
 
-from orion_tpu.utils.exceptions import DuplicateKeyError
+from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
 
 
 def json_default(value):
@@ -528,12 +528,7 @@ class MemoryDB:
     def write(self, collection, data, query=None):
         """Insert when no query; update-many when query given."""
         with self._lock:
-            col = self._col(collection)
-            if query is None:
-                if isinstance(data, (list, tuple)):
-                    return [col.insert(doc) for doc in data]
-                return col.insert(data)
-            return col.update(query, data, many=True)
+            return self._write_locked(collection, data, query)
 
     def update_many(self, collection, pairs):
         """Apply ``[(query, update), ...]`` in order; returns the total
@@ -554,18 +549,69 @@ class MemoryDB:
             col = self._col(collection)
             return sum(col.update(q, u, many=True) for q, u in pairs)
 
+    #: Sub-operations apply_batch accepts — the write-cycle subset of the
+    #: contract (index management stays per-op: it is startup-time work and
+    #: its KeyError semantics don't fit slot outcomes).
+    BATCH_OPS = frozenset({"write", "read", "read_and_write", "count", "remove"})
+
+    def apply_batch(self, ops):
+        """Apply ``[(op, args, kwargs), ...]`` as ONE atomic unit with
+        respect to other clients: the lock is taken once for the whole
+        batch, so no concurrent writer interleaves between slots.  Returns
+        one outcome per op — the op's result, or the exception instance it
+        raised (slot independence: a DuplicateKeyError in slot 3 says
+        nothing about slot 4).  This is the backend primitive the batched
+        storage write path (register_trials & friends) commits through —
+        one lock here, one transaction on SQL, one wire round trip on the
+        network driver, one load/dump cycle on the pickled file.
+
+        An op name outside BATCH_OPS is a programming error and rejects
+        the WHOLE batch before anything applies (every backend and the
+        network server agree on this upfront validation)."""
+        for op, _args, _kwargs in ops:
+            if op not in self.BATCH_OPS:
+                raise DatabaseError(f"bad batch op {op!r}")
+        out = []
+        with self._lock:
+            for op, args, kwargs in ops:
+                try:
+                    out.append(getattr(self, f"_{op}_locked")(*args, **kwargs))
+                except Exception as exc:
+                    out.append(exc)
+        return out
+
+    def _write_locked(self, collection, data, query=None):
+        col = self._col(collection)
+        if query is None:
+            if isinstance(data, (list, tuple)):
+                return [col.insert(doc) for doc in data]
+            return col.insert(data)
+        return col.update(query, data, many=True)
+
+    def _read_locked(self, collection, query=None, projection=None):
+        return self._col(collection).find(query, projection)
+
+    def _read_and_write_locked(self, collection, query, data):
+        return self._col(collection).find_one_and_update(query, data)
+
+    def _count_locked(self, collection, query=None):
+        return self._col(collection).count(query)
+
+    def _remove_locked(self, collection, query=None):
+        return self._col(collection).remove(query)
+
     def read(self, collection, query=None, projection=None):
         with self._lock:
-            return self._col(collection).find(query, projection)
+            return self._read_locked(collection, query, projection)
 
     def read_and_write(self, collection, query, data):
         with self._lock:
-            return self._col(collection).find_one_and_update(query, data)
+            return self._read_and_write_locked(collection, query, data)
 
     def count(self, collection, query=None):
         with self._lock:
-            return self._col(collection).count(query)
+            return self._count_locked(collection, query)
 
     def remove(self, collection, query=None):
         with self._lock:
-            return self._col(collection).remove(query)
+            return self._remove_locked(collection, query)

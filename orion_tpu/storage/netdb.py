@@ -61,7 +61,19 @@ _DB_OPS = frozenset(
         "index_information",
         "drop_index",
         "ping",
+        "batch",
     }
+)
+
+# Sub-ops a batch request may carry: the write-cycle subset — ONE
+# whitelist shared with every in-process backend (index management and
+# ping stay per-request).
+_BATCH_OPS = MemoryDB.BATCH_OPS
+
+# Ops (and batch sub-ops) that dirty the persisted snapshot.
+_MUTATING_OPS = frozenset(
+    {"write", "read_and_write", "remove", "ensure_index", "ensure_indexes",
+     "drop_index"}
 )
 
 
@@ -110,7 +122,28 @@ def _read_line(sock_file):
     line = sock_file.readline(_MAX_LINE)
     if not line:
         return None
+    if not line.endswith(_TERM):
+        # Truncated line (the connection died mid-send): treat as closed,
+        # never dispatch.  A payload cut ONE byte short of its terminator
+        # is still complete JSON, and applying it would break the client's
+        # send-phase retry contract — the resend would double-apply.
+        return None
     return json.loads(line)
+
+
+def _encode_outcome(result):
+    """One batch-slot outcome as a wire response dict — the same encoding
+    ``_dispatch``'s except clauses produce for a standalone request, so the
+    client translates both through one path (``_translate``)."""
+    if not isinstance(result, Exception):
+        return {"ok": True, "result": result}
+    if isinstance(result, DuplicateKeyError):
+        error = "DuplicateKeyError"
+    elif isinstance(result, KeyError):
+        error = "KeyError"
+    else:
+        error = type(result).__name__
+    return {"ok": False, "error": error, "message": str(result)}
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -192,19 +225,70 @@ class _Handler(socketserver.StreamRequestHandler):
                 "error": "AuthenticationError",
                 "message": "authentication required (server started with a secret)",
             }
+        if op == "batch":
+            return self._batch_dispatch(db, request)
         try:
             method = getattr(db, op)
             result = method(*request.get("args", []), **request.get("kwargs", {}))
-            if op in ("write", "read_and_write", "remove", "ensure_index",
-                      "ensure_indexes", "drop_index"):
+            if op in _MUTATING_OPS:
                 self.server.persist_snapshot()
             return {"ok": True, "result": result}
-        except DuplicateKeyError as exc:
-            return {"ok": False, "error": "DuplicateKeyError", "message": str(exc)}
-        except KeyError as exc:
-            return {"ok": False, "error": "KeyError", "message": str(exc)}
+        except Exception as exc:
+            if not isinstance(exc, (DuplicateKeyError, KeyError)):
+                log.exception("op %s failed", op)  # pragma: no cover - defensive
+            return _encode_outcome(exc)
+
+    def _batch_dispatch(self, db, request):
+        """ONE request carrying N sub-operations: applied as one atomic
+        unit against the store (one lock hold on MemoryDB, one transaction
+        on a SQLite-persisted server) and answered with ONE response line
+        holding per-slot outcomes.  Next to ``pipeline`` (N request lines
+        in one send) this drops the server's per-op dispatch/persist cycle
+        and, in SQLite persist mode, q fsyncs down to one."""
+        try:
+            args = request.get("args") or [[]]
+            ops = args[0] if args else []
+            normalized = []
+            for entry in ops:
+                op = (
+                    entry[0]
+                    if isinstance(entry, (list, tuple)) and entry
+                    else None
+                )
+                if op not in _BATCH_OPS:
+                    return {
+                        "ok": False,
+                        "error": "DatabaseError",
+                        "message": f"bad batch sub-op {op!r}",
+                    }
+                sub_args = list(entry[1]) if len(entry) > 1 and entry[1] else []
+                sub_kwargs = dict(entry[2]) if len(entry) > 2 and entry[2] else {}
+                normalized.append((op, sub_args, sub_kwargs))
+        except (TypeError, ValueError, KeyError) as exc:
+            # A malformed payload must get a structured refusal, never kill
+            # the handler without a response line — the client would read
+            # that as applied-or-not-unknowable when nothing was applied.
+            return {
+                "ok": False,
+                "error": "DatabaseError",
+                "message": f"malformed batch request: {exc}",
+            }
+        try:
+            apply_batch = getattr(db, "apply_batch", None)
+            if apply_batch is not None:
+                results = apply_batch(normalized)
+            else:  # pragma: no cover - every in-tree store has apply_batch
+                results = []
+                for op, sub_args, sub_kwargs in normalized:
+                    try:
+                        results.append(getattr(db, op)(*sub_args, **sub_kwargs))
+                    except Exception as exc:
+                        results.append(exc)
+            if any(op in _MUTATING_OPS for op, _, _ in normalized):
+                self.server.persist_snapshot()
+            return {"ok": True, "result": [_encode_outcome(r) for r in results]}
         except Exception as exc:  # pragma: no cover - defensive
-            log.exception("op %s failed", op)
+            log.exception("batch of %d ops failed", len(normalized))
             return {"ok": False, "error": type(exc).__name__, "message": str(exc)}
 
 
@@ -359,6 +443,19 @@ class NetworkDB:
         self._sock = None
         self._file = None
         self._last_used = 0.0
+        #: Socket send/receive cycles since construction (one per _call,
+        #: one per pipeline/batch regardless of op count) — bench.py's
+        #: storage breakdown reads this to prove a q-batch round costs O(1)
+        #: wire round trips.
+        self.round_trips = 0
+        #: Request lines put on the wire: a pipeline of N ops writes N (the
+        #: server runs N dispatch/persist cycles), the batch op writes 1.
+        #: This is the per-round "wire operations" count the breakdown
+        #: reports — the quantity the batch op takes from O(q) to O(1).
+        self.wire_requests = 0
+        # Flipped when a server rejects the batch wire op (pre-batch
+        # server); apply_batch then rides pipeline() instead.
+        self._batch_unsupported = False
 
     # --- wire ----------------------------------------------------------------
     def _connect(self):
@@ -442,6 +539,8 @@ class NetworkDB:
         if response is None:
             raise ConnectionError("server closed the connection")
         self._last_used = time.monotonic()
+        self.round_trips += 1
+        self.wire_requests += 1
         return response
 
     def _probe_idle_connection(self):
@@ -557,7 +656,104 @@ class NetworkDB:
                     f"pipeline of {len(ops)} ops: {exc}"
                 ) from exc
             self._last_used = time.monotonic()
+            self.round_trips += 1
+            self.wire_requests += len(ops)
         return [_translate(r, raise_errors=False) for r in responses]
+
+    def apply_batch(self, ops):
+        """Execute ``[(op, args, kwargs), ...]`` as ONE wire request/response.
+
+        Tighter than :meth:`pipeline` (N request lines, N response lines,
+        N server dispatch/persist cycles in ~1 RTT): the batch rides one
+        request line, the server applies it as one atomic unit against the
+        store — one lock hold, and in ``--persist x.sqlite`` mode ONE
+        transaction/fsync for the whole q-batch — and answers with one
+        response line of per-slot outcomes (results or exception
+        instances, same contract as pipeline).
+
+        The request reuses this instance's persistent socket.  A send-phase
+        failure (EPIPE/ECONNRESET against a socket a restarted server
+        closed) means the request line never fully reached the server, so
+        nothing was applied and a reconnect + single resend is safe; only a
+        failure AFTER the payload was handed off is genuinely unknowable
+        and surfaces as DatabaseError.  Talking to a pre-batch server, the
+        rejected op falls back to :meth:`pipeline` transparently (and stops
+        re-trying the batch op on that instance)."""
+        if not ops:
+            return []
+        if self._batch_unsupported:
+            return self.pipeline(ops)
+        # The batch's single RESPONSE line aggregates every sub-op result;
+        # document-returning ops (read / read_and_write, e.g. a q-batch
+        # reservation's claimed trial docs) at large op counts could push
+        # it past the server's line cap — which the request-side guard
+        # below cannot see.  Chunk those through pipeline's per-op
+        # response lines (still ~1 RTT).
+        if len(ops) > 512 and any(
+            op in ("read", "read_and_write") for op, _, _ in ops
+        ):
+            return self.pipeline(ops)
+        payload = _dumps(
+            {
+                "op": "batch",
+                "args": [[[op, list(args), kwargs] for op, args, kwargs in ops]],
+            }
+        )
+        if len(payload) > _MAX_LINE:
+            # One line over the server's readline cap would be read as a
+            # truncated request and silently dropped (surfacing as a
+            # misleading "connection lost").  pipeline ships one line per
+            # op, so an oversized batch rides it instead.
+            return self.pipeline(ops)
+        with self._lock:
+            response = None
+            for attempt in range(2):
+                try:
+                    # Shrink the applied-or-not window: a socket that sat
+                    # idle across a server restart is ping-probed (and
+                    # reconnected) before the batch rides it — sendall can
+                    # succeed into the kernel buffer of a dead connection.
+                    self._probe_idle_connection()
+                    if self._sock is None:
+                        self._connect()
+                    self._sock.sendall(payload)
+                except (OSError, ConnectionError) as exc:
+                    # Send phase: the request line was not fully delivered
+                    # (a partial line is dropped by the server's readline),
+                    # so retrying on a fresh connection cannot double-apply.
+                    self._close()
+                    if attempt:
+                        raise DatabaseError(
+                            f"cannot send batch of {len(ops)} ops to "
+                            f"{self.host}:{self.port}: {exc}"
+                        ) from exc
+                    continue
+                try:
+                    response = _read_line(self._file)
+                    if response is None:
+                        raise ConnectionError("server closed the connection")
+                except (OSError, ConnectionError, json.JSONDecodeError) as exc:
+                    # Read phase: the server may or may not have applied the
+                    # batch — same contract as a lost in-flight _call.
+                    self._close()
+                    raise DatabaseError(
+                        f"connection to {self.host}:{self.port} lost during "
+                        f"batch of {len(ops)} ops: {exc}"
+                    ) from exc
+                self._last_used = time.monotonic()
+                self.round_trips += 1
+                self.wire_requests += 1
+                break
+        try:
+            outcomes = _translate(response)
+        except DatabaseError as exc:
+            if "bad op 'batch'" in str(exc):
+                # Pre-batch server: nothing was applied (the op was
+                # rejected before dispatch) — downgrade to pipeline.
+                self._batch_unsupported = True
+                return self.pipeline(ops)
+            raise
+        return [_translate(r, raise_errors=False) for r in outcomes]
 
     # --- AbstractDB contract --------------------------------------------------
     def ping(self):

@@ -1,0 +1,49 @@
+"""bench.py schema smoke: ``python bench.py --smoke`` must emit one valid
+JSON line carrying the per-stage breakdown (including the storage stage)
+and the O(1) ``storage_ops_per_round`` counters — so bench schema drift (a
+renamed stage, a dropped counter, a broken import in the storage bench) is
+caught by tier-1 instead of by the next full bench run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+BREAKDOWN_KEYS = (
+    "encode",
+    "upload",
+    "dispatch",
+    "wait_transfer",
+    "decode",
+    "dict_build",
+    "storage_ms",
+)
+
+
+def test_bench_smoke_emits_valid_json_with_breakdown_keys():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--smoke"],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["smoke"] is True
+    breakdown = payload["breakdown_ms"]
+    for key in BREAKDOWN_KEYS:
+        assert key in breakdown, f"breakdown_ms lost its {key!r} stage"
+    for backend in ("sqlite", "network"):
+        assert payload["storage_ms"][backend] > 0
+        # The batched write path commits a whole q-round as ONE transaction
+        # / wire request; a regression to per-trial commits shows up here
+        # as q ops, not O(1).
+        assert payload["storage_ops_per_round"][backend] <= 2, backend

@@ -89,3 +89,72 @@ def test_sigkill_mid_write_leaves_store_consistent(tmp_path, backend):
         db.write("docs", {"seq": -100 - round_, "payload": PAYLOAD, "ok": True})
         if backend == "sqlite":
             db.close()
+
+
+@pytest.mark.parametrize("applied_before_failure", [False, True])
+def test_overlapped_commit_failure_keeps_suggest_batch_consistent(
+    applied_before_failure,
+):
+    """The producer's pipelined commit dispatches the NEXT round's
+    speculative suggest before writing the current batch to storage.  A
+    storage failure inside that overlapped commit must neither lose the
+    in-flight speculative batch (it is consumed and registered by the next
+    round) nor double-register/double-observe the batch that failed.  Both
+    failure shapes are covered: the commit never reached storage, and the
+    genuinely unknowable "applied server-side but the reply was lost" case
+    (the unique index + the producer's duplicate absorption make the retry
+    converge instead of duplicating)."""
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.core.trial import Result
+    from orion_tpu.storage import create_storage
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    storage = create_storage({"type": "memory"})
+    real_register = storage.register_trials
+    state = {"fail_next": False}
+
+    def failing_register(trials):
+        if state["fail_next"]:
+            state["fail_next"] = False
+            if applied_before_failure:
+                real_register(trials)  # applied; the "reply" is then lost
+            raise DatabaseError("connection lost during batch commit")
+        return real_register(trials)
+
+    storage.register_trials = failing_register
+    exp = build_experiment(
+        storage,
+        "exp",
+        priors={"/x": "uniform(0, 1)"},
+        max_trials=100,
+        algorithms="random",
+        pool_size=4,
+    ).instantiate(seed=7)
+    producer = Producer(exp)
+    producer.update()
+    assert producer.produce(4) == 4  # round 0: clean commit + speculation
+    assert producer._speculative is not None
+
+    state["fail_next"] = True
+    producer.update()
+    with pytest.raises(DatabaseError):
+        producer.produce(4)  # round 1: the overlapped commit fails
+
+    producer.update()
+    assert producer.produce(4) == 4  # round 2: recovery
+    trials = exp.fetch_trials()
+    # No double-registration: every stored point is unique, and the failed
+    # batch is either absent (never applied) or present exactly once.
+    assert len({t.id for t in trials}) == len(trials)
+    assert len(trials) == (12 if applied_before_failure else 8)
+
+    # No double-observation: complete everything; each trial feeds the
+    # algorithm exactly once, and a second sync adds nothing.
+    for trial in trials:
+        storage.set_trial_status(trial, "reserved", was="new")
+        storage.update_completed_trial(trial, [Result("obj", "objective", 0.5)])
+    producer.update()
+    assert exp.algorithm.n_observed == len(trials)
+    producer.update()
+    assert exp.algorithm.n_observed == len(trials)

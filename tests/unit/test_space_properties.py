@@ -8,6 +8,12 @@ the DSL accepts.
 """
 
 import numpy as np
+import pytest
+
+# hypothesis is a dev-only extra (pyproject `[project.optional-dependencies]
+# dev`), not a runtime dependency — skip cleanly where it isn't installed
+# instead of erroring the whole collection.
+pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 
 from orion_tpu.space.dsl import build_space

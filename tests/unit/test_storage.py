@@ -11,7 +11,7 @@ import pytest
 
 from orion_tpu.core.trial import Trial
 from orion_tpu.storage import MemoryDB, PickledDB, create_storage
-from orion_tpu.storage.base import DocumentStorage, ReadOnlyStorage
+from orion_tpu.storage.base import BaseStorage, DocumentStorage, ReadOnlyStorage
 from orion_tpu.utils.exceptions import DuplicateKeyError, FailedUpdate
 
 
@@ -843,6 +843,198 @@ def test_network_pipeline_one_round_trip_semantics():
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_sqlite_register_trials_is_one_transaction(tmp_path):
+    """The batched write path on SQLite: a q-batch registration (and a
+    q-batch reservation beyond its probe) costs O(1) transactions — i.e.
+    one COMMIT/fsync cycle — not O(q)."""
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    db = SQLiteDB(str(tmp_path / "one-txn.sqlite"))
+    storage = DocumentStorage(db)
+    before = db.txn_count
+    outcomes = storage.register_trials([new_trial(i) for i in range(32)])
+    assert all(not isinstance(o, Exception) for o in outcomes)
+    assert db.txn_count - before == 1
+    before = db.txn_count
+    got = storage.reserve_trials("exp-id", 32)
+    assert len(got) == 32
+    # One probe claim + one batch transaction for the remaining 31.
+    assert db.txn_count - before == 2
+
+
+def test_sqlite_apply_batch_auto_ids_match_sequential(tmp_path):
+    """Auto-assigned _ids after a mid-batch duplicate: the failed slot's
+    counter draw must roll back with its savepoint exactly like the failed
+    sequential write's transaction does, so both paths hand out identical
+    ids to the surviving slots."""
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    batch_db = SQLiteDB(str(tmp_path / "ids-batch.sqlite"))
+    seq_db = SQLiteDB(str(tmp_path / "ids-seq.sqlite"))
+    docs = [{"u": 1}, {"u": 1}, {"u": 2}]  # slot 1 duplicates slot 0
+    for db in (batch_db, seq_db):
+        db.ensure_index("c", ["u"], unique=True)
+    batch_out = batch_db.apply_batch(
+        [("write", ["c", dict(d)], {}) for d in docs]
+    )
+    seq_out = []
+    for d in docs:
+        try:
+            seq_out.append(seq_db.write("c", dict(d)))
+        except DuplicateKeyError as exc:
+            seq_out.append(exc)
+
+    def norm(outcomes):
+        return ["dup" if isinstance(o, Exception) else o for o in outcomes]
+
+    assert norm(batch_out) == norm(seq_out)
+    assert batch_db.read("c") == seq_db.read("c")
+
+
+def test_network_register_trials_is_one_wire_request():
+    """The batch wire op: a q-batch registration rides ONE request line /
+    ONE response line (vs q lines pipelined, vs q round trips per-op)."""
+    from orion_tpu.storage import DBServer, NetworkDB
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    try:
+        db = NetworkDB(host=host, port=port)
+        storage = DocumentStorage(db)
+        requests_before = db.wire_requests
+        trips_before = db.round_trips
+        outcomes = storage.register_trials([new_trial(i) for i in range(32)])
+        assert all(not isinstance(o, Exception) for o in outcomes)
+        assert db.wire_requests - requests_before == 1
+        assert db.round_trips - trips_before == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_network_batch_reuses_socket_and_reconnects_when_dead(tmp_path):
+    """The batch path rides the instance's ONE persistent socket — no
+    connect-per-request — and a send-phase failure on a dead socket
+    (EPIPE/EBADF after a server restart) reconnects and resends: the
+    request line never reached the server, so the retry cannot
+    double-apply."""
+    from orion_tpu.storage import DBServer, NetworkDB
+
+    snapshot = str(tmp_path / "batch-snap.pkl")
+    server = DBServer(port=0, persist=snapshot)
+    host, port = server.serve_background()
+    db = NetworkDB(host=host, port=port)
+    db.apply_batch([("write", ["c", {"_id": 1}], {})])
+    sock = db._sock
+    db.apply_batch([("write", ["c", {"_id": 2}], {})])
+    db.read("c")
+    db.apply_batch([("write", ["c", {"_id": 3}], {})])
+    assert db._sock is sock  # one socket across batch AND per-op traffic
+    # Kill the connection underneath the client (shutdown, not close: the
+    # makefile reader keeps the fd alive, so close() wouldn't actually
+    # sever it): the next batch must hit the send-phase error, reconnect,
+    # and apply exactly once.
+    import socket as _socket
+
+    db._sock.shutdown(_socket.SHUT_RDWR)
+    db.apply_batch([("write", ["c", {"_id": 4}], {})])
+    assert db._sock is not sock
+    assert db.count("c") == 4
+    # Same guarantee across a real server restart while the client idles
+    # (the probe path): the reconnect re-runs transparently.
+    server.shutdown()
+    server.server_close()
+    server2 = DBServer(host=host, port=port, persist=snapshot)
+    server2.serve_background()
+    try:
+        db.idle_probe = 0.0  # force the pre-batch ping probe
+        outcomes = db.apply_batch([("write", ["c", {"_id": 5}], {})])
+        assert not isinstance(outcomes[0], Exception)
+        assert db.count("c") == 5
+    finally:
+        server2.shutdown()
+        server2.server_close()
+
+
+def test_network_batch_downgrades_to_pipeline_on_old_server(monkeypatch):
+    """Talking to a pre-batch server, the rejected batch op (refused before
+    dispatch — nothing applied) falls back to pipeline transparently and
+    stops retrying the batch op on that instance."""
+    import orion_tpu.storage.netdb as netdb_mod
+    from orion_tpu.storage import DBServer, NetworkDB
+
+    monkeypatch.setattr(
+        netdb_mod, "_DB_OPS", netdb_mod._DB_OPS - {"batch"}
+    )
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    try:
+        db = NetworkDB(host=host, port=port)
+        outcomes = db.apply_batch(
+            [("write", ["c", {"_id": i}], {}) for i in range(3)]
+        )
+        assert all(not isinstance(o, Exception) for o in outcomes)
+        assert db._batch_unsupported
+        assert db.count("c") == 3
+        # Subsequent batches go straight to pipeline.
+        db.apply_batch([("write", ["c", {"_id": 3}], {})])
+        assert db.count("c") == 4
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+class _LoopOnlyStorage(DocumentStorage):
+    """A third-party protocol implementation that never heard of the batch
+    API: it overrides ONLY the singular ops (counting them), so the batch
+    entry points must come from BaseStorage's loop fallbacks."""
+
+    # Sever the DocumentStorage batch overrides — what a plugin subclassing
+    # BaseStorage directly would see.
+    register_trials = BaseStorage.register_trials
+    reserve_trials = BaseStorage.reserve_trials
+    update_completed_trials = BaseStorage.update_completed_trials
+
+    def __init__(self, db):
+        super().__init__(db)
+        self.singular_calls = 0
+
+    def register_trial(self, trial):
+        self.singular_calls += 1
+        return super().register_trial(trial)
+
+    def reserve_trial(self, experiment):
+        self.singular_calls += 1
+        return super().reserve_trial(experiment)
+
+    def update_completed_trial(self, trial, results):
+        self.singular_calls += 1
+        return super().update_completed_trial(trial, results)
+
+
+def test_base_storage_batch_loop_fallbacks():
+    """A custom backend that only implements the per-trial protocol gets
+    register_trials / reserve_trials / update_completed_trials for free
+    (BaseStorage default loops), with identical outcome semantics —
+    duplicates as per-slot exceptions, short reservation on an empty
+    queue."""
+    from orion_tpu.core.trial import Result
+
+    storage = _LoopOnlyStorage(MemoryDB())
+    storage.register_trial(new_trial(1))
+    outcomes = storage.register_trials([new_trial(0), new_trial(1), new_trial(2)])
+    assert not isinstance(outcomes[0], Exception)
+    assert isinstance(outcomes[1], DuplicateKeyError)
+    assert not isinstance(outcomes[2], Exception)
+    got = storage.reserve_trials("exp-id", 10)
+    assert len(got) == 3
+    pairs = [(t, [Result("objective", "objective", 1.0)]) for t in got]
+    done = storage.update_completed_trials(pairs)
+    assert all(not isinstance(o, Exception) for o in done)
+    assert storage.count_completed_trials("exp-id") == 3
+    assert storage.singular_calls >= 3 + 3 + 3  # every op went singular
 
 
 def _net_worker_reserve_batched(host, port, out_queue):

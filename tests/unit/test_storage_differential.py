@@ -189,3 +189,116 @@ def test_backends_agree_on_random_programs(seed, tmp_path):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def _make_trial(exp_id, x, submit_time=1234.5):
+    from orion_tpu.core.trial import Trial
+
+    # submit_time pre-stamped: register_trial stamps time.time() per call
+    # while the batch stamps one shared now — pinning it is what makes
+    # byte-identical comparison meaningful.
+    return Trial(
+        experiment=exp_id, params={"/x": x}, submit_time=submit_time
+    )
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_register_trials_batch_matches_sequential(backend, tmp_path):
+    """The batched write path IS the sequential path: register_trials over
+    a q-batch (including a duplicate point mid-batch) must leave documents
+    and unique-index state byte-identical to N sequential register_trial
+    calls — the duplicate's slot fails with DuplicateKeyError on both
+    sides, rolled back atomically (no stray index entries), without
+    blocking the later slots."""
+    from orion_tpu.storage.base import DocumentStorage
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+    from orion_tpu.utils.exceptions import DuplicateKeyError
+
+    def make_storage(tag):
+        if backend == "sqlite":
+            return DocumentStorage(SQLiteDB(str(tmp_path / f"{tag}.sqlite")))
+        return DocumentStorage(MemoryDB())
+
+    xs = [0.1, 0.2, 0.3, 0.2, 0.4]  # index 3 duplicates index 1
+    batch_storage = make_storage("batch")
+    seq_storage = make_storage("seq")
+
+    batch_outcomes = batch_storage.register_trials(
+        [_make_trial("e", x) for x in xs]
+    )
+    seq_outcomes = []
+    for x in xs:
+        try:
+            seq_outcomes.append(seq_storage.register_trial(_make_trial("e", x)))
+        except DuplicateKeyError as exc:
+            seq_outcomes.append(exc)
+
+    for i, (b, s) in enumerate(zip(batch_outcomes, seq_outcomes)):
+        assert isinstance(b, Exception) == isinstance(s, Exception), (i, b, s)
+        if isinstance(b, Exception):
+            assert isinstance(b, DuplicateKeyError)
+            assert i == 3
+    assert _canonical_state(batch_storage.db, "trials") == _canonical_state(
+        seq_storage.db, "trials"
+    )
+
+    # Index state: the failed slot left no stray unique entries — the SAME
+    # point still collides, and a fresh point registers cleanly, on both.
+    for storage in (batch_storage, seq_storage):
+        [dup_outcome] = storage.register_trials([_make_trial("e", 0.2)])
+        assert isinstance(dup_outcome, DuplicateKeyError)
+        [ok_outcome] = storage.register_trials([_make_trial("e", 0.9)])
+        assert not isinstance(ok_outcome, Exception)
+    assert _canonical_state(batch_storage.db, "trials") == _canonical_state(
+        seq_storage.db, "trials"
+    )
+
+
+def test_apply_batch_agrees_across_backends(tmp_path):
+    """apply_batch (the one-transaction / one-wire-request primitive the
+    batched storage path commits through) must agree with the in-memory
+    oracle slot for slot — results, per-slot exceptions, and final
+    collection state."""
+    from orion_tpu.storage.backends import PickledDB
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    backends = {
+        "memory": MemoryDB(),  # the oracle
+        "sqlite": SQLiteDB(str(tmp_path / "b.sqlite")),
+        "pickled": PickledDB(str(tmp_path / "b.pkl")),
+        "network": NetworkDB(host=host, port=port),
+    }
+    ops = (
+        [("write", ["c", {"_id": f"d{i}", "u": i % 4}], {}) for i in range(6)]
+        + [
+            ("write", ["c", {"_id": "dup", "u": 2}], {}),  # unique conflict
+            ("read_and_write", ["c", {"_id": "d1"}, {"st": 7}], {}),
+            ("count", ["c", {"u": {"$gte": 2}}], {}),
+            ("remove", ["c", {"_id": "d5"}], {}),
+            ("write", ["c", {"missing": 1}, ], {"query": {"_id": "absent"}}),
+            # Empty query dict = update-ALL, never insert (the coalescing
+            # fast path must route on `query is None`, not falsiness).
+            ("write", ["c", {"touched": 1}], {"query": {}}),
+        ]
+    )
+    try:
+        expected = None
+        for name, db in backends.items():
+            db.ensure_index("c", ["u"], unique=True)
+            outcomes = db.apply_batch([(op, list(a), dict(k)) for op, a, k in ops])
+            normalized = [
+                ("exc", type(o).__name__) if isinstance(o, Exception)
+                else ("ok", dumps_canonical(o))
+                for o in outcomes
+            ]
+            state = _canonical_state(db)
+            if expected is None:
+                expected = (normalized, state)
+            else:
+                assert (normalized, state) == expected, name
+    finally:
+        server.shutdown()
+        server.server_close()
