@@ -284,6 +284,61 @@ def bench_breakdown(rounds=4, q=Q, algo=None, n_hist=130):
     return {k: round(1e3 * float(np.median(v)), 3) for k, v in stages.items()}
 
 
+def bench_trace(out_path, rounds=3, q=16):
+    """Run a few REAL producer rounds (sqlite storage, speculation-safe
+    random search) and one GP suggest pair with the unified telemetry
+    registry enabled, then export the process's span ring as a Chrome
+    trace-event JSON — the artifact every bench run leaves behind so the
+    PR-2 pipelined commit is *visible*: in Perfetto the round's
+    ``storage.commit`` span runs concurrently under the open
+    ``device.dispatch`` window (speculative suggest in flight while the
+    batched register writes).  The GP pair adds the
+    ``jax.suggest_step.compile`` (first call, retrace) and
+    ``jax.suggest_step.dispatch`` (second call, cache hit) spans.
+
+    Telemetry is enabled ONLY inside this phase, so the timed benches above
+    keep measuring the disabled-path cost (the production default)."""
+    import os
+    import tempfile
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.storage.base import create_storage
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-trace-") as tmpdir:
+            storage = create_storage(
+                {"type": "sqlite", "path": os.path.join(tmpdir, "trace.sqlite")}
+            )
+            experiment = build_experiment(
+                storage,
+                "bench-trace",
+                priors={f"x{i}": "uniform(0, 1)" for i in range(4)},
+                algorithms={"random": {"seed": SEED}},
+                metadata={"user": "bench"},
+            )
+            experiment.instantiate(seed=SEED)
+            producer = Producer(experiment)
+            for _ in range(rounds):
+                producer.update()
+                producer.produce(q)
+            producer._flush_timings(force_metrics=True)
+        algo = _make_algo(seed=SEED + 4, n_candidates=256, fit_steps=4)
+        rng = np.random.default_rng(SEED + 4)
+        X = rng.uniform(size=(16, 6)).astype(np.float32)
+        _observe(algo, X, _hartmann6_np(X))
+        algo.suggest(8)  # compile -> jax.suggest_step.compile span
+        algo.suggest(8)  # cache hit -> jax.suggest_step.dispatch span
+        tel.TELEMETRY.export_chrome_trace(out_path)
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    return out_path
+
+
 def bench_device_decomposition():
     """Device-vs-tunnel split of one fused suggest round at the headline
     shape (two-chain-length subtraction; suggest_bench.py is the full
@@ -315,6 +370,10 @@ def _json_payload(
         "metric": metric,
         "value": value,
         "unit": "suggestions/sec",
+        # Chrome trace-event JSON of a traced producer-round + GP-suggest
+        # phase (bench_trace) — load in Perfetto; None only if tracing
+        # itself failed (reported but never fatal to the bench).
+        "trace_file": None,
         "vs_baseline": vs_baseline,
         "regret": regret,
         "anchor_regret": anchor_regret,
@@ -340,9 +399,9 @@ def _json_payload(
     return payload
 
 
-def main(smoke=False):
+def main(smoke=False, trace_out="bench_trace.json"):
     if smoke:
-        return main_smoke()
+        return main_smoke(trace_out=trace_out)
     ours_sps = bench_throughput()
     breakdown = bench_breakdown()
     device_ms = bench_device_decomposition()
@@ -360,62 +419,80 @@ def main(smoke=False):
         f"regret parity failed: ours={ours_regret:.6f} "
         f"anchor={anchor_regret:.6f} tol={REGRET_TOL}"
     )
-    print(
-        json.dumps(
-            _json_payload(
-                metric=(
-                    "suggestions/sec @ q=1024, Hartmann6 "
-                    "(public suggest/observe, refit per round)"
-                ),
-                value=round(ours_sps, 2),
-                vs_baseline=round(ours_sps / anchor_sps, 2),
-                regret=round(ours_regret, 6),
-                anchor_regret=round(anchor_regret, 6),
-                wall_ms_per_round=round(1e3 * Q / ours_sps, 2),
-                device_ms_per_round=round(device_ms, 2),
-                breakdown_ms=breakdown,
-                storage_ms=storage_ms,
-                storage_ops_per_round=storage_ops,
-            )
-        )
+    trace_file = _safe_trace(trace_out)
+    payload = _json_payload(
+        metric=(
+            "suggestions/sec @ q=1024, Hartmann6 "
+            "(public suggest/observe, refit per round)"
+        ),
+        value=round(ours_sps, 2),
+        vs_baseline=round(ours_sps / anchor_sps, 2),
+        regret=round(ours_regret, 6),
+        anchor_regret=round(anchor_regret, 6),
+        wall_ms_per_round=round(1e3 * Q / ours_sps, 2),
+        device_ms_per_round=round(device_ms, 2),
+        breakdown_ms=breakdown,
+        storage_ms=storage_ms,
+        storage_ops_per_round=storage_ops,
     )
+    payload["trace_file"] = trace_file
+    print(json.dumps(payload))
 
 
-def main_smoke():
+def _safe_trace(trace_out):
+    """Run the trace phase; a tracing failure must cost the bench its
+    artifact, never its numbers."""
+    import traceback
+
+    try:
+        return bench_trace(trace_out)
+    except Exception:
+        traceback.print_exc()
+        return None
+
+
+def main_smoke(trace_out="bench_trace.json"):
     """Tiny-n schema smoke: the same JSON line shape in seconds instead of
     minutes — no regret parity, no sklearn anchor, no device
     decomposition.  The tier-1 bench smoke test runs ``bench.py --smoke``
-    and asserts the breakdown/storage keys, so schema drift (a renamed
-    stage, a dropped counter) is caught by the unit suite instead of the
-    next full bench run."""
+    and asserts the breakdown/storage keys AND the emitted trace file's
+    span names, so bench schema drift (a renamed stage, a dropped counter,
+    a broken trace export) is caught by the unit suite instead of the next
+    full bench run."""
     q = 32
     algo = _make_algo(seed=SEED + 2, n_candidates=512, fit_steps=8)
     breakdown = bench_breakdown(rounds=1, q=q, algo=algo, n_hist=20)
     storage_ms, storage_ops = bench_storage(q=64, rounds=1)
     breakdown["storage_ms"] = storage_ms["sqlite"]
-    print(
-        json.dumps(
-            _json_payload(
-                metric=(
-                    f"SMOKE (q={q}): schema check only — run without "
-                    "--smoke for the headline numbers"
-                ),
-                value=None,
-                vs_baseline=None,
-                regret=None,
-                anchor_regret=None,
-                wall_ms_per_round=None,
-                device_ms_per_round=None,
-                breakdown_ms=breakdown,
-                storage_ms=storage_ms,
-                storage_ops_per_round=storage_ops,
-                smoke=True,
-            )
-        )
+    trace_file = _safe_trace(trace_out)
+    payload = _json_payload(
+        metric=(
+            f"SMOKE (q={q}): schema check only — run without "
+            "--smoke for the headline numbers"
+        ),
+        value=None,
+        vs_baseline=None,
+        regret=None,
+        anchor_regret=None,
+        wall_ms_per_round=None,
+        device_ms_per_round=None,
+        breakdown_ms=breakdown,
+        storage_ms=storage_ms,
+        storage_ops_per_round=storage_ops,
+        smoke=True,
     )
+    payload["trace_file"] = trace_file
+    print(json.dumps(payload))
 
 
 if __name__ == "__main__":
     import sys
 
-    main(smoke="--smoke" in sys.argv[1:])
+    argv = sys.argv[1:]
+    out = "bench_trace.json"
+    if "--trace-out" in argv:
+        at = argv.index("--trace-out")
+        if at + 1 >= len(argv):
+            sys.exit("bench.py: --trace-out requires a path argument")
+        out = argv[at + 1]
+    main(smoke="--smoke" in argv, trace_out=out)

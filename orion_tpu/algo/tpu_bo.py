@@ -13,11 +13,14 @@ fantasize-don't-refit design SURVEY.md §7 calls for — the naive-algo copy
 refits its posterior with fantasy rows instead of waiting on stragglers.
 """
 
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from orion_tpu.telemetry import TELEMETRY
 
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
 from orion_tpu.algo.gp.acquisition import (
@@ -674,6 +677,19 @@ def run_suggest_step_arrays(
     warm = warm_state.hypers if warm_state is not None else init_hypers(width)
     if warm_state is not None and refit_steps is not None:
         fit_steps = refit_steps
+    # Telemetry: jax dispatch is asynchronous, so this span is the HOST
+    # cost of the fused step — tracing + lowering + compile on a cache
+    # miss, ~argument-handling microseconds on a hit.  The jit cache size
+    # before/after distinguishes the two (a growth IS a retrace), which is
+    # how `orion-tpu info` counts recompiles a production hunt paid.
+    tel_t0 = cache_size = None
+    if TELEMETRY.enabled:
+        cache_size = getattr(_suggest_step, "_cache_size", None)
+        try:
+            tel_before = cache_size() if cache_size is not None else -1
+        except Exception:  # private jax API — degrade, never raise into suggest
+            cache_size, tel_before = None, -1
+        tel_t0 = time.perf_counter()
     rows, state = _suggest_step(
         key,
         x,
@@ -697,6 +713,18 @@ def run_suggest_step_arrays(
         fixed_tail_cols=fixed_tail_cols,
         mesh=mesh,
     )
+    if tel_t0 is not None:
+        try:
+            retraced = cache_size is not None and cache_size() > tel_before
+        except Exception:  # private jax API — degrade, never raise into suggest
+            retraced = False
+        TELEMETRY.record_span(
+            "jax.suggest_step.compile" if retraced else "jax.suggest_step.dispatch",
+            start=tel_t0,
+            args={"q": int(num), "n": int(x.shape[0])},
+        )
+        if retraced:
+            TELEMETRY.count("jax.retraces")
     # Dedup ordered unique draws first, so the first `num` rows are the ones
     # the un-padded call would have returned.  Rows come back as a DEVICE
     # array slice: jax dispatch is asynchronous, so callers that defer the

@@ -102,7 +102,17 @@ def load_cli_config(args):
             "type": _storage_type_for_path(args.storage_path),
             "path": args.storage_path,
         }
-    return resolve_config(file_config, cmd_config, storage_override)
+    config = resolve_config(file_config, cmd_config, storage_override)
+    # `telemetry:` in any config layer flips the process-wide registry; a
+    # None (unset) leaves whatever ORION_TPU_TELEMETRY decided at import.
+    if config.get("telemetry") is not None:
+        from orion_tpu.telemetry import TELEMETRY
+
+        if config["telemetry"]:
+            TELEMETRY.enable()
+        else:
+            TELEMETRY.disable()
+    return config
 
 
 def _default_user():

@@ -68,6 +68,11 @@ def format_info(experiment):
     if perf:
         out.append(_section("Performance"))
         out.extend(perf)
+
+    tele = _telemetry_section(experiment)
+    if tele:
+        out.append(_section("Telemetry"))
+        out.extend(tele)
     return "\n".join(out) + "\n"
 
 
@@ -98,6 +103,43 @@ def _perf_section(experiment):
             f"p99 {pct(99) * 1e3:.1f}ms  max {durations[-1] * 1e3:.1f}ms"
         )
     return lines
+
+
+def _telemetry_section(experiment):
+    """The unified-telemetry block: per-op latency percentiles from the
+    merged cross-worker histogram snapshots (orion_tpu.telemetry), plus
+    the counters (jax retraces, storage transactions/wire requests/
+    reconnects, lost-trial sweeps) and gauges each worker flushed through
+    the storage metrics channel.  Empty unless a hunt ran with
+    ``ORION_TPU_TELEMETRY=1`` (or ``telemetry: true``).  The WHOLE section
+    is guarded, not just the fetch: a malformed doc (third-party backend,
+    corruption) must drop this block, never take down ``info``."""
+    from orion_tpu.telemetry import histogram_percentile, merge_snapshots
+
+    try:
+        docs = experiment.storage.fetch_metrics(experiment)
+        if not docs:
+            return []
+        merged = merge_snapshots(docs)
+        lines = [f"workers reporting: {len(docs)}"]
+        for name, hist in sorted(merged["histograms"].items()):
+            if not hist.get("count"):
+                continue
+            p50, p90, p99 = (
+                histogram_percentile(hist, p) * 1e3 for p in (50, 90, 99)
+            )
+            lines.append(
+                f"{name}: {hist['count']} samples | "
+                f"p50 {p50:.1f}ms  p90 {p90:.1f}ms  p99 {p99:.1f}ms  "
+                f"max {hist.get('max', 0.0) * 1e3:.1f}ms"
+            )
+        for name, value in sorted(merged["counters"].items()):
+            lines.append(f"{name}: {value}")
+        for name, value in sorted(merged["gauges"].items()):
+            lines.append(f"{name}: {value:.4g}")
+        return lines
+    except Exception:
+        return []
 
 
 def main(args):

@@ -1,0 +1,65 @@
+"""`orion-tpu trace`: export an experiment's merged telemetry trace.
+
+No reference counterpart — part of the TPU build's unified telemetry
+subsystem (orion_tpu.telemetry).  Workers running with telemetry enabled
+flush their span records through the storage channel every producer round;
+this command merges every worker's spans into one Chrome trace-event JSON
+(load it in Perfetto / chrome://tracing — each worker process appears as
+its own track, and the pipelined storage commit shows up as a
+``storage.commit`` span running concurrently with the ``device.dispatch``
+window) or, with ``--format jsonl``, one span per line for ad-hoc tooling.
+"""
+
+import json
+
+from orion_tpu.cli.base import add_experiment_args, build_from_args
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser(
+        "trace", help="export the merged telemetry trace of an experiment"
+    )
+    add_experiment_args(parser, with_user_args=False)
+    parser.add_argument(
+        "--out",
+        default="trace.json",
+        metavar="path",
+        help="output file (default: trace.json)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("chrome", "jsonl"),
+        default="chrome",
+        help="chrome = trace-event JSON for Perfetto (default); "
+        "jsonl = one span object per line",
+    )
+    parser.set_defaults(func=main)
+    return parser
+
+
+def main(args):
+    from orion_tpu.telemetry import write_chrome_trace
+
+    experiment, _parser = build_from_args(
+        args, need_user_args=False, allow_create=False, view=True
+    )
+    spans = experiment.storage.fetch_spans(experiment)
+    if not spans:
+        print(
+            f"no spans recorded for experiment {experiment.name!r} — run the "
+            "hunt with ORION_TPU_TELEMETRY=1 (or `telemetry: true` in the "
+            "config) to collect them"
+        )
+        return 1
+    if args.format == "jsonl":
+        with open(args.out, "w") as handle:
+            for span in spans:
+                handle.write(json.dumps(span) + "\n")
+    else:
+        write_chrome_trace(args.out, spans)
+    workers = {s.get("worker") for s in spans if s.get("worker")}
+    print(
+        f"wrote {len(spans)} spans from {max(len(workers), 1)} worker(s) "
+        f"to {args.out}"
+    )
+    return 0

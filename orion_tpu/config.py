@@ -83,6 +83,10 @@ DEFAULTS = {
     "max_idle_time": 60.0,
     "user_script_config": "config",
     "storage": {"type": "pickled", "path": "orion_tpu_db.pkl"},
+    # Framework telemetry (orion_tpu.telemetry): None = leave the
+    # process-wide registry as the ORION_TPU_TELEMETRY env var set it;
+    # true/false here overrides (the CLI applies it in load_cli_config).
+    "telemetry": None,
 }
 
 

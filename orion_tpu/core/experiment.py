@@ -15,6 +15,7 @@ from orion_tpu.algo.base import create_algo
 from orion_tpu.core.strategy import create_strategy
 from orion_tpu.core.trial import Trial
 from orion_tpu.space.dsl import build_space
+from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import (
     DuplicateKeyError,
     FailedUpdate,
@@ -92,10 +93,12 @@ class Experiment:
         """Sweep reserved trials with stale heartbeats back to reservable
         (the elastic-recovery story; reference `experiment.py:217-232`)."""
         self._last_lost_sweep = time.monotonic()
+        TELEMETRY.count("experiment.lost_trial_sweeps")
         for trial in self._storage.fetch_lost_trials(self._id, self.heartbeat):
             try:
                 self._storage.set_trial_status(trial, "interrupted", was="reserved")
                 log.info("Recovered lost trial %s", trial.id)
+                TELEMETRY.count("experiment.lost_trials_recovered")
             except FailedUpdate:
                 pass  # another worker got there first — fine
 

@@ -7,7 +7,9 @@ the update fails (meaning another actor transitioned it).
 """
 
 import threading
+import time
 
+from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import FailedUpdate
 
 DEFAULT_WAIT_TIME = 60.0
@@ -25,7 +27,21 @@ class TrialPacemaker(threading.Thread):
         self._stop_event.set()
 
     def run(self):
+        beat_due = time.perf_counter() + self.wait_time
         while not self._stop_event.wait(self.wait_time):
+            # Heartbeat lag: how far past the scheduled beat this one fires
+            # (event-wait jitter + the PREVIOUS beat's storage-write time —
+            # beat_due is re-anchored at wake, before this beat's write, so
+            # a slow/flapping storage backend shows up in the next wake's
+            # lag instead of being absorbed).  A lag approaching the
+            # lost-trial sweep threshold means live trials are at risk of
+            # being recovered as lost — exported as a gauge so `orion-tpu
+            # info` surfaces it per worker fleet.
+            now = time.perf_counter()
+            TELEMETRY.set_gauge(
+                "pacemaker.heartbeat_lag_s", max(0.0, now - beat_due)
+            )
+            beat_due = now + self.wait_time
             try:
                 self.storage.update_heartbeat(self.trial)
             except FailedUpdate:

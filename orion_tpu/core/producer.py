@@ -17,6 +17,7 @@ import time
 import numpy as np
 
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Result, Trial
+from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import (
     AlgorithmExhausted,
     DuplicateKeyError,
@@ -42,6 +43,12 @@ def _observe_accepts_cube(algo):
 
 
 class Producer:
+    #: Minimum seconds between metrics-snapshot upserts: _flush_timings
+    #: runs from both update() and produce(), and the snapshot (every
+    #: histogram's full bucket array) is the heaviest telemetry write —
+    #: a q-round's worth of freshness is plenty for `orion-tpu info`.
+    METRICS_FLUSH_INTERVAL = 2.0
+
     def __init__(self, experiment, max_idle_time=None):
         from orion_tpu.core.experiment import DEFAULT_MAX_IDLE_TIME
 
@@ -79,11 +86,18 @@ class Producer:
         self._n_in_flight = 0  # status == reserved (someone is executing)
         self._n_reservable = 0  # new/suspended/interrupted (worker can consume)
         self._pending_timings = []
+        self._last_metrics_flush = float("-inf")
         self._n_completed_seen = 0
         self._update_epoch = 0
         # Speculative next-round suggestion: (handle, algo) dispatched at the
         # end of produce() so the device round trip overlaps trial execution.
         self._speculative = None
+        # perf_counter at the live speculative dispatch: the open
+        # ``device.dispatch`` telemetry span covering the async device
+        # window (dispatch -> finalize/discard) — the span the storage
+        # commit visibly overlaps with in a trace.  None when telemetry is
+        # disabled or nothing is in flight.
+        self._spec_window_t0 = None
         # Trial ids already conditioned (register_suggestion + lie) onto the
         # CURRENT naive copy by _dispatch_speculative: the pipelined commit
         # may re-invoke it on the same instance (mid-loop dispatch opted
@@ -204,18 +218,49 @@ class Producer:
 
     def _record_timing(self, op, duration, count):
         """Buffer a timing sample; flushed once per produce()/update() round
-        so telemetry never adds a storage write inside the hot retry loop."""
-        self._pending_timings.append((op, duration, count))
+        so telemetry never adds a storage write inside the hot retry loop.
 
-    def _flush_timings(self):
-        """Telemetry must never break the run (SURVEY §5 timing hooks)."""
-        if not self._pending_timings:
-            return
+        The same sample also feeds the process-wide telemetry registry as a
+        ``producer.{op}`` span + histogram entry (one clock reading, two
+        sinks) — the storage-persisted timing channel is unchanged."""
+        self._pending_timings.append((op, duration, count))
+        TELEMETRY.record_span(
+            f"producer.{op}", duration=duration, args={"count": count}
+        )
+
+    def _flush_timings(self, force_metrics=False):
+        """Telemetry must never break the run (SURVEY §5 timing hooks).
+
+        Flushes the buffered timing samples through the legacy storage
+        channel AND, when the telemetry registry is enabled, this worker's
+        new span records (drained once each) + a metrics snapshot upsert —
+        so ``orion-tpu info``/``trace`` aggregate across worker processes.
+        The snapshot upsert is time-gated (METRICS_FLUSH_INTERVAL): this
+        runs from update() AND produce(), and re-upserting an
+        all-histograms snapshot twice per round would tax the very storage
+        hot path the pipelined commit freed.  ``force_metrics`` (the
+        end-of-run flush) bypasses the gate so final totals always land."""
         samples, self._pending_timings = self._pending_timings, []
+        if not samples and not TELEMETRY.enabled:
+            return
         try:
-            self.experiment.storage.record_timings(self.experiment, samples)
+            if samples:
+                self.experiment.storage.record_timings(self.experiment, samples)
+            if TELEMETRY.enabled:
+                spans = TELEMETRY.drain_spans()
+                if spans:
+                    self.experiment.storage.record_spans(self.experiment, spans)
+                now = time.monotonic()
+                if (
+                    force_metrics
+                    or now - self._last_metrics_flush >= self.METRICS_FLUSH_INTERVAL
+                ):
+                    self.experiment.storage.record_metrics(
+                        self.experiment, TELEMETRY.snapshot()
+                    )
+                    self._last_metrics_flush = now
         except Exception:  # pragma: no cover - read-only/remote storage quirks
-            log.debug("could not record timings", exc_info=True)
+            log.debug("could not record telemetry", exc_info=True)
 
     def _update_naive_algorithm(self, incomplete):
         """Naive algo = deepcopy of real + lies for in-flight trials
@@ -281,6 +326,10 @@ class Producer:
         caller against itself (``ExperimentClient.suggest`` holding a
         partial batch) — so the wait only applies when reserved trials
         beyond the caller's own exist."""
+        with TELEMETRY.span("producer.round"):
+            return self._produce(pool_size, own_in_flight)
+
+    def _produce(self, pool_size, own_in_flight):
         pool_size = pool_size or self.experiment.pool_size
         registered = 0
         start = time.time()
@@ -384,6 +433,7 @@ class Producer:
                     # on it must go — same contract as the per-slot discard
                     # below.
                     self._speculative = None
+                    self._close_spec_window("discarded")
                 raise
             self._record_timing("register", time.perf_counter() - t0, len(batch))
             had_duplicate = False
@@ -417,6 +467,7 @@ class Producer:
                 # not register; drop the handle — the post-loop dispatch
                 # (or the next round's) redoes it from the true set.
                 self._speculative = None
+                self._close_spec_window("discarded")
             if batch_error is not None:
                 raise batch_error
             if had_duplicate:
@@ -427,6 +478,15 @@ class Producer:
         return registered
 
     # --- speculative overlap ------------------------------------------------
+    def _close_spec_window(self, outcome):
+        """Close the open ``device.dispatch`` span (if any): the async device
+        work window from speculative dispatch to finalize/discard."""
+        t0, self._spec_window_t0 = self._spec_window_t0, None
+        if t0 is not None:
+            TELEMETRY.record_span(
+                "device.dispatch", start=t0, args={"outcome": outcome}
+            )
+
     def _dispatch_speculative(self, pool_size, registered_trials):
         """Dispatch the NEXT round's device suggest before this round's
         trials execute (VERDICT r2 #3: the small-batch presets were pinned
@@ -446,9 +506,11 @@ class Producer:
         commit path uses this to know the storage write it is about to
         issue overlaps live device work."""
         self._speculative = None
+        self._close_spec_window("discarded")
         algo = self.naive_algorithm
         if algo is None or not getattr(algo, "speculation_safe", False):
             return False
+        t_dispatch = time.perf_counter() if TELEMETRY.enabled else None
         try:
             # Condition each trial onto this naive copy AT MOST ONCE: the
             # pipelined commit may re-invoke this on the same instance
@@ -486,12 +548,22 @@ class Producer:
         except Exception:  # pragma: no cover - speculation must never break a run
             log.debug("speculative dispatch failed", exc_info=True)
             return False
+        if t_dispatch is not None:
+            # Host-side cost of conditioning + async dispatch (the span the
+            # issue calls ``speculative_dispatch``); the device-work window
+            # itself is the separate open ``device.dispatch`` span below.
+            TELEMETRY.record_span(
+                "producer.speculative_dispatch",
+                start=t_dispatch,
+                args={"dispatched": handle is not None},
+            )
         if handle is None:
             return False
         # Keep the real algo's rng stream ahead of the speculative draw, or
         # the next naive copy would replay the same key and duplicate it.
         self.algorithm.rng_key = algo.rng_key
         self._speculative = (handle, algo)
+        self._spec_window_t0 = t_dispatch
         return True
 
     def _take_speculative(self, pool_size):
@@ -505,9 +577,11 @@ class Producer:
             # Timed as "suggest": what remains of the device round trip
             # after the overlap (ideally just the residual transfer).
             self._record_timing("suggest", time.perf_counter() - t0, len(out))
+            self._close_spec_window("finalized")
             return out
         except Exception:  # pragma: no cover - speculation must never break a run
             log.debug("speculative finalize failed", exc_info=True)
+            self._close_spec_window("failed")
             return None
 
     def backoff(self):

@@ -58,6 +58,29 @@ def workon(
     )
 
     iterations = 0
+    try:
+        iterations = _workon_loop(
+            experiment, producer, consumer, worker_trials, on_error
+        )
+    finally:
+        # Final telemetry flush: the last round's spans/metrics (including
+        # the closing producer.round span) would otherwise die with the
+        # process instead of reaching the storage channel `orion-tpu
+        # info`/`trace` aggregate from.  Fire-and-forget by contract;
+        # force_metrics bypasses the per-round upsert gate so the worker's
+        # final counter totals always land.
+        producer._flush_timings(force_metrics=True)
+    if experiment.is_broken:
+        # The budget may be exhausted on the very last worker iteration —
+        # still a broken experiment, not a clean exit.
+        raise BrokenExperiment(
+            f"experiment {experiment.name} has too many broken trials"
+        )
+    return iterations
+
+
+def _workon_loop(experiment, producer, consumer, worker_trials, on_error):
+    iterations = 0
     while iterations < worker_trials:
         if experiment.is_broken:
             log.error(
@@ -90,12 +113,6 @@ def workon(
         if not success and on_error is not None:
             on_error(trial)
         iterations += 1
-    if experiment.is_broken:
-        # The budget may be exhausted on the very last worker iteration —
-        # still a broken experiment, not a clean exit.
-        raise BrokenExperiment(
-            f"experiment {experiment.name} has too many broken trials"
-        )
     return iterations
 
 

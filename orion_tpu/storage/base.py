@@ -10,11 +10,13 @@ Timestamps are ``time.time()`` floats everywhere (device-friendly and
 pickle-stable), not datetimes.
 """
 
+import functools
 import time
 
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Trial
 from orion_tpu.storage.backends import PickledDB
 from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import DatabaseError, FailedUpdate
 
 
@@ -81,6 +83,24 @@ class BaseStorage:
     def register_lie(self, trial):
         raise NotImplementedError
 
+    # --- framework telemetry channel (optional capability) ------------------
+    # Default no-ops so third-party storage protocols that predate the
+    # telemetry subsystem keep satisfying the worker flush path (which is
+    # fire-and-forget anyway: the producer wraps it in try/except).
+    def record_metrics(self, experiment, snapshot, worker=None):
+        """Upsert one worker's telemetry metrics snapshot."""
+
+    def fetch_metrics(self, experiment):
+        """All workers' metric snapshot docs for ``experiment``."""
+        return []
+
+    def record_spans(self, experiment, spans):
+        """Append drained span records for ``experiment``."""
+
+    def fetch_spans(self, experiment):
+        """Every stored span record for ``experiment``, time-ordered."""
+        return []
+
     def fetch_lies(self, experiment):
         raise NotImplementedError
 
@@ -131,7 +151,58 @@ INDEX_SPECS = [
     ("trials", ["status"], False),
     ("trials", ["experiment", "status"], False),
     ("lying_trials", ["experiment"], False),
+    # Unified-telemetry channel: spans are counted/pruned and metrics
+    # upserted by (experiment, worker) on every worker flush round.
+    ("metrics", ["experiment"], False),
+    ("spans", ["experiment"], False),
 ]
+
+
+#: Telemetry label per backend class; unknown (third-party) backends fall
+#: back to their lowercased class name.
+_BACKEND_LABELS = {
+    "MemoryDB": "memory",
+    "PickledDB": "pickled",
+    "SQLiteDB": "sqlite",
+    "NetworkDB": "network",
+}
+
+#: Backend-maintained monotonic counters re-exported through the telemetry
+#: registry (sampled at snapshot time — zero hot-path cost).
+_BACKEND_COUNTER_ATTRS = ("txn_count", "wire_requests", "round_trips", "reconnects")
+
+
+def _traced(op, span_name=None):
+    """Time a DocumentStorage protocol op into the telemetry registry: a
+    ``storage.{op}`` span (overridable — ``register_trials`` reports as
+    ``storage.commit``, the produce round's write) plus a per-backend
+    per-op latency histogram ``storage.{backend}.{op}``.  Disabled
+    telemetry costs one attribute check."""
+
+    def decorate(fn):
+        name = span_name or f"storage.{op}"
+
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            if not TELEMETRY.enabled:
+                return fn(self, *args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(self, *args, **kwargs)
+            finally:
+                duration = time.perf_counter() - t0
+                backend = self._backend_label
+                # histogram=False: the sample's ONE histogram home is the
+                # per-backend key below — same-name span histograms would
+                # double every snapshot's payload and duplicate info rows.
+                TELEMETRY.record_span(
+                    name, start=t0, args={"backend": backend}, histogram=False
+                )
+                TELEMETRY.observe(f"storage.{backend}.{op}", duration)
+
+        return wrapper
+
+    return decorate
 
 
 class DocumentStorage(BaseStorage):
@@ -139,6 +210,14 @@ class DocumentStorage(BaseStorage):
 
     def __init__(self, db):
         self._db = db
+        self._backend_label = _BACKEND_LABELS.get(
+            type(db).__name__, type(db).__name__.lower()
+        )
+        for attr in _BACKEND_COUNTER_ATTRS:
+            if isinstance(getattr(db, attr, None), int):
+                TELEMETRY.register_external_counter(
+                    f"storage.{self._backend_label}.{attr}", db, attr
+                )
         self._setup_indexes()
 
     @property
@@ -183,6 +262,7 @@ class DocumentStorage(BaseStorage):
         return self._db.read("experiments", query, projection)
 
     # --- trials -------------------------------------------------------------
+    @_traced("register_trial")
     def register_trial(self, trial):
         """Insert a new trial; DuplicateKeyError on a duplicate point id."""
         trial.submit_time = trial.submit_time or time.time()
@@ -219,6 +299,7 @@ class DocumentStorage(BaseStorage):
         }
         return query, update
 
+    @_traced("reserve_trial")
     def reserve_trial(self, experiment):
         """Atomically claim one pending trial (the cross-worker sync point;
         reference `legacy.py:253-273`)."""
@@ -248,6 +329,7 @@ class DocumentStorage(BaseStorage):
             return apply_batch(ops)
         return self._db.pipeline(ops)
 
+    @_traced("reserve_trials")
     def reserve_trials(self, experiment, num):
         """Claim up to ``num`` pending trials; each claim is individually
         atomic (repeated find-one-and-updates — every op sees the previous
@@ -301,6 +383,7 @@ class DocumentStorage(BaseStorage):
         # surface on the next (empty-handed) round.
         return out
 
+    @_traced("register_trials", span_name="storage.commit")
     def register_trials(self, trials):
         """Batch-register; returns one outcome per trial: the trial itself on
         success or the per-trial exception (DuplicateKeyError for an
@@ -322,6 +405,7 @@ class DocumentStorage(BaseStorage):
             for trial, result in zip(trials, results)
         ]
 
+    @_traced("update_completed_trials")
     def update_completed_trials(self, pairs):
         """Batch-complete ``[(trial, results), ...]`` — one backend round
         (one transaction on SQL, one wire request on the network driver);
@@ -363,6 +447,7 @@ class DocumentStorage(BaseStorage):
                 outcomes.append(trial)
         return outcomes
 
+    @_traced("fetch_trials")
     def fetch_trials(self, experiment=None, uid=None):
         query = {"experiment": uid if uid is not None else _exp_id(experiment)}
         docs = self._db.read("trials", query)
@@ -381,6 +466,7 @@ class DocumentStorage(BaseStorage):
             query["_id"] = {"$in": list(ids)}
         return self._db.read("trials", query, projection=projection)
 
+    @_traced("fetch_update_view")
     def fetch_update_view(self, experiment, known_completed=-1):
         """The producer's per-round sync snapshot: ``(trials, n_completed)``.
 
@@ -441,6 +527,7 @@ class DocumentStorage(BaseStorage):
         docs = self._db.read("trials", {"_id": _id})
         return Trial.from_dict(docs[0]) if docs else None
 
+    @_traced("set_trial_status")
     def set_trial_status(self, trial, status, was=None):
         """Compare-and-swap status update (reference `legacy.py:223-243`).
 
@@ -461,6 +548,7 @@ class DocumentStorage(BaseStorage):
         trial.status = status
         return Trial.from_dict(doc)
 
+    @_traced("update_heartbeat")
     def update_heartbeat(self, trial):
         doc = self._db.read_and_write(
             "trials",
@@ -493,6 +581,7 @@ class DocumentStorage(BaseStorage):
             raise FailedUpdate(f"cannot push results of non-reserved trial {trial.id}")
         return Trial.from_dict(doc)
 
+    @_traced("update_completed_trial")
     def update_completed_trial(self, trial, results):
         trial.results = list(results)
         trial.end_time = time.time()
@@ -567,6 +656,68 @@ class DocumentStorage(BaseStorage):
         docs.sort(key=lambda d: d.get("time") or 0.0)
         return docs
 
+    # --- unified telemetry channel (orion_tpu.telemetry snapshots/spans) ----
+    #: Span documents are pruned past this per-experiment count (same
+    #: unbounded-growth guard as TELEMETRY_CAP for timing samples).
+    SPANS_CAP = 20000
+
+    def record_metrics(self, experiment, snapshot, worker=None):
+        """Upsert one worker's metrics snapshot (``Telemetry.snapshot()``)
+        keyed by (experiment, worker) — counters/histograms are per-worker
+        monotonic totals, so the latest doc supersedes earlier ones and
+        ``fetch_metrics`` + ``telemetry.merge_snapshots`` aggregate across
+        the fleet.  ``worker`` defaults to this process's host:pid."""
+        exp_id = _exp_id(experiment)
+        worker = worker or _worker_id()
+        doc = {
+            "experiment": exp_id,
+            "worker": worker,
+            "time": time.time(),
+            "counters": dict(snapshot.get("counters") or {}),
+            "gauges": dict(snapshot.get("gauges") or {}),
+            "histograms": dict(snapshot.get("histograms") or {}),
+        }
+        updated = self._db.write(
+            "metrics", doc, query={"experiment": exp_id, "worker": worker}
+        )
+        if not updated:
+            self._db.write("metrics", doc)
+
+    def fetch_metrics(self, experiment):
+        docs = self._db.read("metrics", {"experiment": _exp_id(experiment)})
+        docs.sort(key=lambda d: d.get("time") or 0.0)
+        return docs
+
+    def record_spans(self, experiment, spans):
+        """Append drained span records (``Telemetry.drain_spans()``) in ONE
+        backend write; prunes the oldest past :attr:`SPANS_CAP`."""
+        if not spans:
+            return
+        exp_id = _exp_id(experiment)
+        worker = _worker_id()
+        self._db.write(
+            "spans",
+            [{"experiment": exp_id, "worker": worker, **span} for span in spans],
+        )
+        n = self._db.count("spans", {"experiment": exp_id})
+        if n > self.SPANS_CAP:
+            # Prune with hysteresis — down to 90% of the cap, not exactly
+            # to it: a prune-to-cap would leave the collection full, so
+            # EVERY later flush re-pays the full fetch+sort+remove on the
+            # producer's hot path; the 10% slack amortizes it to one prune
+            # per ~2k spans.
+            keep = max(1, int(self.SPANS_CAP * 0.9))
+            docs = self.fetch_spans(experiment)  # ts-sorted ascending
+            cutoff = docs[n - keep].get("ts") or 0.0
+            self._db.remove(
+                "spans", {"experiment": exp_id, "ts": {"$lt": cutoff}}
+            )
+
+    def fetch_spans(self, experiment):
+        docs = self._db.read("spans", {"experiment": _exp_id(experiment)})
+        docs.sort(key=lambda d: d.get("ts") or 0.0)
+        return docs
+
     def fetch_noncompleted_trials(self, experiment):
         docs = self._db.read(
             "trials",
@@ -611,6 +762,8 @@ _READONLY_METHODS = {
     "count_completed_trials",
     "count_broken_trials",
     "fetch_timings",
+    "fetch_metrics",
+    "fetch_spans",
 }
 
 

@@ -36,6 +36,7 @@ import time
 
 from orion_tpu.storage.backends import atomic_pickle_dump
 from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import (
     AuthenticationError,
     DatabaseError,
@@ -453,6 +454,12 @@ class NetworkDB:
         #: This is the per-round "wire operations" count the breakdown
         #: reports — the quantity the batch op takes from O(q) to O(1).
         self.wire_requests = 0
+        #: Re-established connections (any _connect after the first):
+        #: restarts, idle-probe failures, send-phase EPIPE resends.  A
+        #: rising rate is THE first symptom of a flapping server/link —
+        #: exported as the ``storage.network.reconnects`` telemetry counter.
+        self.reconnects = 0
+        self._ever_connected = False
         # Flipped when a server rejects the batch wire op (pre-batch
         # server); apply_batch then rides pipeline() instead.
         self._batch_unsupported = False
@@ -461,6 +468,9 @@ class NetworkDB:
     def _connect(self):
         self._close()
         sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        if self._ever_connected:
+            self.reconnects += 1
+        self._ever_connected = True
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
         self._file = sock.makefile("rb")
@@ -533,7 +543,10 @@ class NetworkDB:
     _IDEMPOTENT = frozenset({"read", "count", "index_information", "ping"})
 
     def _exchange(self, payload):
-        """One request/response on the current socket; raises on any break."""
+        """One request/response on the current socket; raises on any break.
+        Round-trip latency feeds the ``storage.network.rtt`` telemetry
+        histogram when the registry is enabled."""
+        t0 = time.perf_counter() if TELEMETRY.enabled else None
         self._sock.sendall(payload)
         response = _read_line(self._file)
         if response is None:
@@ -541,6 +554,8 @@ class NetworkDB:
         self._last_used = time.monotonic()
         self.round_trips += 1
         self.wire_requests += 1
+        if t0 is not None:
+            TELEMETRY.observe("storage.network.rtt", time.perf_counter() - t0)
         return response
 
     def _probe_idle_connection(self):
@@ -624,6 +639,7 @@ class NetworkDB:
             # pipeline deadlocks once a big batch fills both kernel socket
             # buffers — the server blocks writing responses nobody reads,
             # stops consuming requests, and the client's sendall blocks too.
+            rtt_t0 = time.perf_counter() if TELEMETRY.enabled else None
             responses, reader_error = [], []
 
             def _drain():
@@ -658,6 +674,14 @@ class NetworkDB:
             self._last_used = time.monotonic()
             self.round_trips += 1
             self.wire_requests += len(ops)
+            if rtt_t0 is not None:
+                # One histogram sample per socket round trip, same as
+                # _exchange — the batch paths are the produce round's
+                # dominant wire ops and must not be invisible in the rtt
+                # signal.
+                TELEMETRY.observe(
+                    "storage.network.rtt", time.perf_counter() - rtt_t0
+                )
         return [_translate(r, raise_errors=False) for r in responses]
 
     def apply_batch(self, ops):
@@ -716,6 +740,7 @@ class NetworkDB:
                     self._probe_idle_connection()
                     if self._sock is None:
                         self._connect()
+                    rtt_t0 = time.perf_counter() if TELEMETRY.enabled else None
                     self._sock.sendall(payload)
                 except (OSError, ConnectionError) as exc:
                     # Send phase: the request line was not fully delivered
@@ -743,6 +768,10 @@ class NetworkDB:
                 self._last_used = time.monotonic()
                 self.round_trips += 1
                 self.wire_requests += 1
+                if rtt_t0 is not None:
+                    TELEMETRY.observe(
+                        "storage.network.rtt", time.perf_counter() - rtt_t0
+                    )
                 break
         try:
             outcomes = _translate(response)

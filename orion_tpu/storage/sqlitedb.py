@@ -18,7 +18,9 @@ import functools
 import json
 import sqlite3
 import threading
+import time
 
+from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.storage.documents import (
     MemoryDB,
     apply_update,
@@ -132,12 +134,18 @@ class SQLiteDB:
         return conn
 
     class _Txn:
-        """IMMEDIATE transaction: the cross-process synchronization point."""
+        """IMMEDIATE transaction: the cross-process synchronization point.
+
+        Wall time from BEGIN to COMMIT/ROLLBACK (lock wait + statements +
+        WAL sync) feeds the ``storage.sqlite.txn`` telemetry histogram —
+        the commit-latency signal next to the ``txn_count`` counter."""
 
         def __init__(self, conn):
             self.conn = conn
+            self._t0 = None
 
         def __enter__(self):
+            self._t0 = time.perf_counter() if TELEMETRY.enabled else None
             self.conn.execute("BEGIN IMMEDIATE")
             return self.conn
 
@@ -146,6 +154,10 @@ class SQLiteDB:
                 self.conn.execute("COMMIT")
             else:
                 self.conn.execute("ROLLBACK")
+            if self._t0 is not None:
+                TELEMETRY.observe(
+                    "storage.sqlite.txn", time.perf_counter() - self._t0
+                )
 
     def _txn(self):
         with self._txn_count_lock:

@@ -1,0 +1,446 @@
+"""Unified framework telemetry: metrics registry + span tracer + exporters.
+
+One process-wide :class:`Telemetry` registry replaces the three dialects the
+stack grew organically — producer ``_record_timing`` samples, storage
+``txn_count``/``wire_requests`` counters, bench ``breakdown_ms`` stages —
+with primitives that can all be correlated in time:
+
+- **counters** (monotonic ints), **gauges** (last-set floats), and
+  **histograms** (fixed log2 buckets over seconds — mergeable across
+  workers by summing buckets, percentile-queryable without storing samples);
+- a **span tracer**: monotonic-clock ``(name, ts, dur, pid, tid)`` records
+  in a preallocated ring buffer, exported as JSONL or Chrome trace-event
+  JSON (loads directly in Perfetto / chrome://tracing).
+
+The registry is near-zero-cost when disabled: every mutator early-returns
+on one attribute check, and ``span()`` returns a shared no-op context
+manager — no locks, no allocations, no clock reads.  Toggle with the
+``ORION_TPU_TELEMETRY`` env var (``1/on/true/yes``), the ``telemetry:``
+config key, or programmatically via ``TELEMETRY.enable()``.
+
+Contract shared with the producer's ``_flush_timings``: telemetry must
+never raise into a hot path.  Mutators swallow their own failures; only
+the explicit exporters propagate I/O errors.
+
+Cross-worker story: each worker flushes ``snapshot()`` (metrics) and
+``drain_spans()`` (new span records) through the storage channel
+(``DocumentStorage.record_metrics`` / ``record_spans``) every producer
+round; ``orion-tpu info`` merges the snapshots with
+:func:`merge_snapshots`, and ``orion-tpu trace`` merges every worker's
+spans into one Chrome trace (span timestamps are wall-anchored monotonic
+readings, so processes line up on a shared timeline).
+"""
+
+import json
+import os
+import threading
+import time
+import weakref
+
+_ENABLE_VALUES = ("1", "on", "true", "yes")
+
+#: Histogram shape: bucket ``i`` counts durations in ``[2**(i-1), 2**i)``
+#: microseconds (bucket 0 is < 1 µs).  48 buckets reach ~1.6 days — far
+#: past any single operation this framework times.  FIXED across versions:
+#: merged snapshots sum buckets elementwise, so every writer must agree.
+N_BUCKETS = 48
+
+DEFAULT_SPAN_CAPACITY = 4096
+
+
+def _bucket_of(seconds):
+    """Index of the log2-µs bucket holding ``seconds``."""
+    micros = int(seconds * 1e6)
+    if micros <= 0:
+        return 0
+    return min(micros.bit_length(), N_BUCKETS - 1)
+
+
+def bucket_upper_seconds(index):
+    """Upper bound (seconds) of bucket ``index`` — what percentile queries
+    report (conservative: the true sample is at most this)."""
+    return float(2**index) / 1e6
+
+
+class _NullSpan:
+    """The disabled-path span: ONE shared instance, allocation-free."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """An enabled span: records itself into the registry on exit."""
+
+    __slots__ = ("_telemetry", "name", "args", "_t0")
+
+    def __init__(self, telemetry, name, args):
+        self._telemetry = telemetry
+        self.name = name
+        self.args = args
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._telemetry.record_span(self.name, start=self._t0, args=self.args)
+        return False
+
+
+class Telemetry:
+    """Process-wide counters/gauges/histograms + span ring buffer.
+
+    Thread-safe: one registry lock guards every mutation.  Recording rates
+    are per-operation (a handful per producer round), so lock contention is
+    not a concern — the DISABLED path is the one that must stay free, and
+    it never touches the lock.
+    """
+
+    def __init__(self, enabled=None, span_capacity=None):
+        if enabled is None:
+            enabled = (
+                os.environ.get("ORION_TPU_TELEMETRY", "").strip().lower()
+                in _ENABLE_VALUES
+            )
+        if span_capacity is None:
+            try:
+                span_capacity = int(
+                    os.environ.get("ORION_TPU_TELEMETRY_SPANS", "")
+                    or DEFAULT_SPAN_CAPACITY
+                )
+            except ValueError:
+                span_capacity = DEFAULT_SPAN_CAPACITY
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+        # name -> [buckets list, count, sum, min, max]
+        self._histograms = {}
+        # name -> list of (weakref, attr): external monotonic counters
+        # (SQLiteDB.txn_count, NetworkDB.wire_requests, ...) sampled at
+        # snapshot time — zero hot-path cost for the owning backend.
+        self._external = {}
+        # Preallocated span ring: slot i%capacity holds span seq i.
+        self._capacity = max(int(span_capacity), 8)
+        self._ring = [None] * self._capacity
+        self._seq = 0
+        self._drained = 0
+        # Wall anchor: ts_wall = _anchor + perf_counter reading.  Spans use
+        # the monotonic clock for start/duration; the anchor puts every
+        # process on one comparable wall timeline at export/merge time.
+        self._anchor = time.time() - time.perf_counter()
+
+    # --- toggling -----------------------------------------------------------
+    def enable(self):
+        self.enabled = True
+
+    def disable(self):
+        self.enabled = False
+
+    # --- metrics ------------------------------------------------------------
+    def count(self, name, n=1):
+        """Increment counter ``name`` by ``n``."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def set_gauge(self, name, value):
+        """Set gauge ``name`` to ``value`` (last write wins)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name, seconds):
+        """Record one duration sample into histogram ``name``."""
+        if not self.enabled:
+            return
+        seconds = float(seconds)
+        with self._lock:
+            self._observe_locked(name, seconds)
+
+    def _observe_locked(self, name, seconds):
+        """THE histogram update — callers hold the registry lock.  Shared
+        by observe() and record_span() so the two sample sources can never
+        drift apart."""
+        hist = self._histograms.get(name)
+        if hist is None:
+            hist = [[0] * N_BUCKETS, 0, 0.0, seconds, seconds]
+            self._histograms[name] = hist
+        hist[0][_bucket_of(seconds)] += 1
+        hist[1] += 1
+        hist[2] += seconds
+        hist[3] = min(hist[3], seconds)
+        hist[4] = max(hist[4], seconds)
+
+    def register_external_counter(self, name, obj, attr):
+        """Expose ``obj.attr`` (a monotonic int the owner already maintains,
+        e.g. ``SQLiteDB.txn_count``) as counter ``name``.  Sampled lazily at
+        snapshot time; held by weakref so registration never extends the
+        owner's lifetime.  Multiple registrations under one name sum."""
+        try:
+            ref = weakref.ref(obj)
+        except TypeError:  # pragma: no cover - exotic objects without weakref
+            return
+        with self._lock:
+            self._external.setdefault(name, []).append((ref, attr))
+
+    def _external_counts(self):
+        out = {}
+        with self._lock:
+            for name, entries in list(self._external.items()):
+                live = [(ref, attr) for ref, attr in entries if ref() is not None]
+                if not live:
+                    del self._external[name]
+                    continue
+                self._external[name] = live
+                total = 0
+                for ref, attr in live:
+                    owner = ref()
+                    if owner is not None:
+                        try:
+                            total += int(getattr(owner, attr, 0))
+                        except Exception:  # pragma: no cover - hostile attr
+                            pass
+                out[name] = total
+        return out
+
+    # --- spans --------------------------------------------------------------
+    def span(self, name, args=None):
+        """Context manager timing a block.  Disabled: the shared no-op
+        singleton (no allocation, no clock read).  Enabled: records a span
+        AND a duration sample into the histogram of the same name."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args)
+
+    def record_span(self, name, start=None, duration=None, args=None, histogram=True):
+        """Record one finished span explicitly.
+
+        ``start``/``duration`` are ``time.perf_counter()`` readings/deltas;
+        give either or both (a missing start is back-computed from now, a
+        missing duration runs to now).  Callers that already measured a
+        phase (the producer's ``_record_timing``) route through here so the
+        span and its histogram sample come from the same clock reading.
+        ``histogram=False`` records the span only — for call sites that
+        feed a differently-keyed histogram themselves (the storage layer's
+        per-backend op histograms) and must not double-book the sample."""
+        if not self.enabled:
+            return
+        try:
+            now = time.perf_counter()
+            if start is None:
+                duration = float(duration or 0.0)
+                start = now - duration
+            elif duration is None:
+                duration = now - start
+            record = {
+                "name": name,
+                "ts": self._anchor + start,
+                "dur": float(duration),
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+            }
+            if args:
+                record["args"] = dict(args)
+            with self._lock:
+                self._ring[self._seq % self._capacity] = record
+                self._seq += 1
+                if histogram:
+                    self._observe_locked(name, float(duration))
+        except Exception:  # pragma: no cover - must never raise into hot path
+            pass
+
+    def iter_spans(self):
+        """Every span currently in the ring, oldest first (wraparound has
+        dropped anything older than ``capacity`` records)."""
+        with self._lock:
+            start = max(0, self._seq - self._capacity)
+            return [self._ring[i % self._capacity] for i in range(start, self._seq)]
+
+    def drain_spans(self):
+        """Spans recorded since the last drain (each span is returned
+        exactly once — the worker flush channel).  Wraparound between
+        drains loses the overwritten oldest records, by design."""
+        with self._lock:
+            start = max(self._drained, self._seq - self._capacity)
+            out = [self._ring[i % self._capacity] for i in range(start, self._seq)]
+            self._drained = self._seq
+            return out
+
+    # --- snapshots / merging ------------------------------------------------
+    def snapshot(self):
+        """One mergeable metrics snapshot: counters (external ones sampled
+        now), gauges, histograms.  This is the document a worker flushes
+        through ``DocumentStorage.record_metrics`` every round."""
+        external = self._external_counts()
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = {
+                name: {
+                    "buckets": list(hist[0]),
+                    "count": hist[1],
+                    "sum": hist[2],
+                    "min": hist[3],
+                    "max": hist[4],
+                }
+                for name, hist in self._histograms.items()
+            }
+        for name, value in external.items():
+            counters[name] = counters.get(name, 0) + value
+        return {"counters": counters, "gauges": gauges, "histograms": histograms}
+
+    def reset(self):
+        """Drop every metric and span, INCLUDING external-counter
+        registrations (test/bench isolation: a still-alive backend's
+        monotonic txn/wire totals must not bleed into a fresh measurement;
+        a backend created after the reset re-registers on construction)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+            self._external.clear()
+            self._ring = [None] * self._capacity
+            self._seq = 0
+            self._drained = 0
+
+    # --- exporters ----------------------------------------------------------
+    def export_jsonl(self, path):
+        """One JSON object per line: every span in the ring, then one
+        ``{"type": "metrics", ...}`` snapshot line."""
+        spans = self.iter_spans()
+        with open(path, "w") as handle:
+            for span in spans:
+                handle.write(json.dumps({"type": "span", **span}) + "\n")
+            handle.write(json.dumps({"type": "metrics", **self.snapshot()}) + "\n")
+        return path
+
+    def export_chrome_trace(self, path):
+        """Chrome trace-event JSON of the ring (loads in Perfetto)."""
+        return write_chrome_trace(path, self.iter_spans())
+
+
+def histogram_percentile(hist, p):
+    """Nearest-rank percentile (seconds) from a snapshot histogram dict —
+    the upper bound of the bucket holding the rank, so the report is
+    conservative within one 2x bucket."""
+    count = int(hist.get("count", 0))
+    if count <= 0:
+        return 0.0
+    rank = max(1, -(-int(p * count) // 100))  # ceil(p/100 * count)
+    seen = 0
+    for index, n in enumerate(hist.get("buckets", ())):
+        seen += n
+        if seen >= rank:
+            return min(bucket_upper_seconds(index), float(hist.get("max", 0.0)))
+    return float(hist.get("max", 0.0))
+
+
+def merge_snapshots(snapshots):
+    """Aggregate worker snapshot docs into one: counters and histogram
+    buckets SUM (they are per-worker monotonic totals); gauges merge by
+    MAX — they are risk signals (heartbeat lag), and the worker whose
+    gauge matters is exactly the stalled one that stopped flushing, so
+    freshest-write-wins would mask it behind a healthy worker's ~0.
+    Accepts raw ``snapshot()`` dicts or storage docs carrying extra keys
+    (``experiment``/``worker``/``time``)."""
+    counters = {}
+    gauges = {}
+    histograms = {}
+    for doc in snapshots:
+        for name, value in (doc.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(value)
+        for name, value in (doc.get("gauges") or {}).items():
+            value = float(value)
+            gauges[name] = max(gauges[name], value) if name in gauges else value
+        for name, hist in (doc.get("histograms") or {}).items():
+            merged = histograms.get(name)
+            if merged is None:
+                histograms[name] = {
+                    "buckets": list(hist.get("buckets") or [0] * N_BUCKETS),
+                    "count": int(hist.get("count", 0)),
+                    "sum": float(hist.get("sum", 0.0)),
+                    "min": float(hist.get("min", 0.0)),
+                    "max": float(hist.get("max", 0.0)),
+                }
+                continue
+            buckets = hist.get("buckets") or ()
+            for index, n in enumerate(buckets):
+                if index < len(merged["buckets"]):
+                    merged["buckets"][index] += n
+            merged["count"] += int(hist.get("count", 0))
+            merged["sum"] += float(hist.get("sum", 0.0))
+            merged["min"] = min(merged["min"], float(hist.get("min", 0.0)))
+            merged["max"] = max(merged["max"], float(hist.get("max", 0.0)))
+    return {"counters": counters, "gauges": gauges, "histograms": histograms}
+
+
+def chrome_trace_events(spans):
+    """Span records -> Chrome trace-event dicts (complete 'X' events, µs).
+
+    Spans may come from one process's ring or from the storage channel
+    (several workers).  Tracks are keyed by the WORKER identity (host:pid
+    when present — a bare OS pid collides across hosts, e.g. two
+    containerized workers both running as pid 1), mapped to synthetic
+    sequential pids; each track gets a process_name metadata event so
+    Perfetto labels the rows."""
+    events = []
+    tracks = {}  # worker label -> synthetic pid
+    for span in spans:
+        if not span:
+            continue
+        label = str(span.get("worker") or f"orion-tpu:{span.get('pid', 0)}")
+        if label not in tracks:
+            tracks[label] = len(tracks) + 1
+        event = {
+            "name": str(span.get("name", "?")),
+            "cat": str(span.get("name", "?")).split(".", 1)[0],
+            "ph": "X",
+            "ts": float(span.get("ts", 0.0)) * 1e6,
+            "dur": float(span.get("dur", 0.0)) * 1e6,
+            "pid": tracks[label],
+            "tid": int(span.get("tid", 0)),
+        }
+        args = span.get("args")
+        if args:
+            event["args"] = dict(args)
+        events.append(event)
+    for label, pid in tracks.items():
+        events.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": label},
+            }
+        )
+    return events
+
+
+def write_chrome_trace(path, spans):
+    """Write ``spans`` as a Chrome trace-event JSON file (Perfetto-ready)."""
+    payload = {
+        "traceEvents": chrome_trace_events(spans),
+        "displayTimeUnit": "ms",
+    }
+    with open(path, "w") as handle:
+        json.dump(payload, handle)
+    return path
+
+
+#: THE process-wide registry every subsystem records into.  Enabled state
+#: comes from ORION_TPU_TELEMETRY at import; the CLI layers the
+#: ``telemetry:`` config key on top (cli/base.py).
+TELEMETRY = Telemetry()

@@ -2,7 +2,11 @@
 JSON line carrying the per-stage breakdown (including the storage stage)
 and the O(1) ``storage_ops_per_round`` counters — so bench schema drift (a
 renamed stage, a dropped counter, a broken import in the storage bench) is
-caught by tier-1 instead of by the next full bench run.
+caught by tier-1 instead of by the next full bench run.  Every run also
+writes a Chrome trace-event file whose top-level span names (producer
+round, storage commit, async device-dispatch window, jax compile-vs-cached
+dispatch) and commit/dispatch overlap are asserted here — the pipelined
+producer commit's visibility contract.
 """
 
 import json
@@ -24,11 +28,39 @@ BREAKDOWN_KEYS = (
     "storage_ms",
 )
 
+#: Spans every bench trace must carry: the produce round, its batched
+#: storage write, the async device window the write overlaps with, and the
+#: fused GP step's dispatch.
+TRACE_SPAN_NAMES = (
+    "producer.round",
+    "producer.suggest",
+    "storage.commit",
+    "device.dispatch",
+    "jax.suggest_step.dispatch",
+)
 
-def test_bench_smoke_emits_valid_json_with_breakdown_keys():
+
+def _retrace_introspection_available():
+    """The compile-vs-cached split rides jax's PRIVATE PjitFunction
+    ``_cache_size`` accessor; product code degrades gracefully without it
+    (everything reports as ``dispatch``), so the compile-span assertion
+    must degrade the same way instead of failing on a jax upgrade."""
+    from orion_tpu.algo.tpu_bo import _suggest_step
+
+    return hasattr(_suggest_step, "_cache_size")
+
+
+def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path):
+    trace_path = tmp_path / "trace.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--smoke"],
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "bench.py"),
+            "--smoke",
+            "--trace-out",
+            str(trace_path),
+        ],
         capture_output=True,
         text=True,
         timeout=560,
@@ -47,3 +79,25 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys():
         # / wire request; a regression to per-trial commits shows up here
         # as q ops, not O(1).
         assert payload["storage_ops_per_round"][backend] <= 2, backend
+
+    # --- the telemetry trace artifact ------------------------------------
+    assert payload["trace_file"] == str(trace_path)
+    with open(trace_path) as handle:
+        trace = json.load(handle)
+    events = trace["traceEvents"]
+    names = {e["name"] for e in events}
+    expected = TRACE_SPAN_NAMES
+    if _retrace_introspection_available():
+        expected += ("jax.suggest_step.compile",)
+    for span in expected:
+        assert span in names, f"bench trace lost its {span!r} span"
+    # The PR-2 pipelined commit is visible as CONCURRENT spans: the round's
+    # batched register (storage.commit) runs inside the open async
+    # device-dispatch window.
+    commits = [e for e in events if e["name"] == "storage.commit"]
+    windows = [e for e in events if e["name"] == "device.dispatch"]
+    assert any(
+        w["ts"] < c["ts"] and c["ts"] + c["dur"] < w["ts"] + w["dur"]
+        for c in commits
+        for w in windows
+    ), "storage.commit no longer overlaps the device.dispatch window"
