@@ -23,17 +23,15 @@ Design (BOHB-flavored, TPU-first):
 
 import logging
 
-import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.algo.asha import ASHA
 from orion_tpu.algo.base import algo_registry
-from orion_tpu.algo.history import DeviceHistory
+from orion_tpu.algo.history import DeviceHistory, HostHistory, _next_pow2
+from orion_tpu.algo.prewarm import DEFAULT_PREWARM_FILL, BucketPrewarmer
 from orion_tpu.algo.sampling import clamp_objectives
 from orion_tpu.algo.tpu_bo import (
-    copula_transform,
-    local_subset_indices,
-    run_suggest_step,
+    maybe_prewarm_fused_step,
     run_suggest_step_arrays,
     tr_update_batch,
 )
@@ -81,6 +79,8 @@ class ASHABO(ASHA):
         tr_local_m=512,
         tr_perturb_dims=20,
         tr_update_every=None,
+        prewarm=True,
+        prewarm_fill=DEFAULT_PREWARM_FILL,
         n_devices=None,
         use_mesh=False,
     ):
@@ -100,7 +100,8 @@ class ASHABO(ASHA):
             tr_length_max=tr_length_max, tr_succ_tol=tr_succ_tol,
             tr_fail_tol=tr_fail_tol, tr_improve_tol=tr_improve_tol,
             tr_local_m=tr_local_m, tr_perturb_dims=tr_perturb_dims,
-            tr_update_every=tr_update_every,
+            tr_update_every=tr_update_every, prewarm=prewarm,
+            prewarm_fill=prewarm_fill,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
@@ -124,6 +125,8 @@ class ASHABO(ASHA):
         self.tr_local_m = tr_local_m
         self.tr_perturb_dims = tr_perturb_dims
         self.tr_update_every = tr_update_every
+        self.prewarm = bool(prewarm)
+        self.prewarm_fill = float(prewarm_fill)
         # Same mesh semantics as TPUBO: shard the candidate axis of the fused
         # suggest step over the devices (BASELINE config #5 names q=4096 on a
         # v5e-8 — the model-based variant must scale the same way).
@@ -138,16 +141,25 @@ class ASHABO(ASHA):
             max(np.log(max(fid.high, 1)) - self._log_low, 1e-9)
         )
         d = space.n_cols
-        self._mf_x = np.zeros((0, d), dtype=np.float32)  # unit-cube points
-        self._mf_s = np.zeros((0,), dtype=np.float32)  # normalized fidelity
-        self._mf_y = np.zeros((0,), dtype=np.float32)
-        # Device-resident augmented history [x | s] (the GP's actual input
-        # columns), incrementally appended on observe — the full-history
-        # suggest path reads it in place instead of re-uploading (see
-        # orion_tpu.algo.history).  Host mirrors stay authoritative for
-        # rung bookkeeping, subset selection, and state_dict.
+        # Host history of augmented rows [x | s] with objectives y:
+        # amortized-growth buffers, O(batch) appends, incrementally-tracked
+        # global incumbent (see HostHistory) — replaces the np.concatenate
+        # `_mf_*` mirrors that cost O(n) host work per observe.
+        self._host = HostHistory(d + 1)
+        # Device-resident twin of the augmented history (the GP's actual
+        # input columns), incrementally appended on observe — suggest reads
+        # it in place (full history or on-device local subset), and the
+        # copula transform runs in-jit, so no O(n) re-upload happens.
         self._hist = DeviceHistory(d + 1)
         self._gp_state = None
+        self._prewarmer = BucketPrewarmer()
+        self._last_q_bucket = None
+        # Best observation at the highest observed fidelity tier, tracked
+        # incrementally (O(batch) per observe; full rescan only when a new
+        # top tier appears — once per rung level, not per round).
+        self._s_top = -np.inf
+        self._top_best_idx = -1
+        self._top_best_y = np.inf
         # Trust-region-style local radius (TuRBO-lite): the GP's global
         # signal is weak in high dimensions, so progress rides the local
         # ball around the incumbent — expand it while improving, shrink it
@@ -156,10 +168,25 @@ class ASHABO(ASHA):
         self._best_seen = np.inf
 
     # Naive-copy sharing (base __deepcopy__): the fitted GP state
-    # (n_pad x n_pad Cholesky), the append-only observation arrays, and the
-    # (uncopyable) mesh handle.  `_hist` is NOT shared by ref — its own
-    # __deepcopy__ does copy-on-write of the device buffers (see tpu_bo).
-    _share_by_ref = ("space", "_gp_state", "_mf_x", "_mf_s", "_mf_y", "_mesh")
+    # (n_pad x n_pad Cholesky), the (uncopyable) mesh handle, and the
+    # prewarmer (threads/locks; the jit cache it warms is process-wide).
+    # `_hist`/`_host` are NOT shared by ref — their own __deepcopy__ does
+    # copy-on-write of the buffers (see tpu_bo/history).
+    _share_by_ref = ("space", "_gp_state", "_mesh", "_prewarmer")
+
+    # Back-compat views over the augmented host history (host consumers
+    # and tests read these; appends go through `_host`).
+    @property
+    def _mf_x(self):
+        return self._host.x[:, : self.space.n_cols]
+
+    @property
+    def _mf_s(self):
+        return self._host.x[:, self.space.n_cols]
+
+    @property
+    def _mf_y(self):
+        return self._host.y
 
     # --- observation ---------------------------------------------------------
     def _fid_norm(self, fidelity):
@@ -192,11 +219,13 @@ class ASHABO(ASHA):
         rows32 = np.asarray(rows, dtype=np.float32)
         s32 = np.asarray(svals, dtype=np.float32)
         y32 = y.astype(np.float32)
-        self._mf_x = np.concatenate([self._mf_x, rows32])
-        self._mf_s = np.concatenate([self._mf_s, s32])
-        self._mf_y = np.concatenate([self._mf_y, y32])
-        # Incremental device append of the augmented rows [x | s].
-        self._hist.append(np.concatenate([rows32, s32[:, None]], axis=1), y32)
+        prev_count = self._host.count
+        aug = np.concatenate([rows32, s32[:, None]], axis=1)
+        # O(batch) host append + O(batch) incremental device append of the
+        # augmented rows [x | s] — no O(n) concatenate per observe.
+        self._host.append(aug, y32)
+        self._hist.append(aug, y32)
+        self._update_top_tier(prev_count, s32, y32)
         prev_best = self._best_seen
         batch_best = float(np.min(y))
         if batch_best < self._best_seen - 1e-9:
@@ -208,7 +237,7 @@ class ASHABO(ASHA):
         # counted on model rounds only; objectives are comparable across
         # fidelities for the box signal (a better low-fid value still marks
         # progress).
-        if self.trust_region and self._mf_y.shape[0] - len(yvals) >= self.n_init:
+        if self.trust_region and prev_count >= self.n_init:
             # Default cadence here is ONE update per observe round (chunk =
             # whole batch), unlike TPUBO's batch-decoupled 8: a rung batch
             # mixes fidelities, and chunk-wise accounting over mixed-budget
@@ -227,24 +256,48 @@ class ASHABO(ASHA):
                 length_max=self.tr_length_max,
                 improve_tol=self.tr_improve_tol,
             )
+        # LAST, after the sigma/box updates above: the fused step's
+        # local_sigma static is quantized from _sigma, and warming before
+        # the update would compile a stale signature the boundary-crossing
+        # suggest never hits.
+        self._maybe_prewarm(batch=len(y32))
+
+    def _update_top_tier(self, prev_count, s32, y32):
+        """Incremental best-at-top-fidelity-tier tracking.
+
+        Old path re-scanned the whole history per suggest
+        (``s >= s.max() - 1e-6`` + masked argmin, O(n)).  Fidelity values
+        are computed identically per rung, so tier membership is exact
+        float equality in practice; a batch that RAISES the top tier
+        triggers one full rescan (happens once per rung level over a run),
+        anything else updates from the batch in O(batch)."""
+        batch_top = float(np.max(s32))
+        if batch_top > self._s_top + 1e-9:
+            # New top tier: previous tier's best no longer qualifies.
+            self._s_top = batch_top
+            s_all, y_all = self._mf_s, self._mf_y
+            pool = np.nonzero(s_all >= self._s_top - 1e-6)[0]
+            at = pool[int(np.argmin(y_all[pool]))]
+            self._top_best_idx = int(at)
+            self._top_best_y = float(y_all[at])
+            return
+        in_tier = np.nonzero(s32 >= self._s_top - 1e-6)[0]
+        if in_tier.size:
+            at = in_tier[int(np.argmin(y32[in_tier]))]
+            # Strict <: ties keep the earliest index, matching the old
+            # full-scan argmin.
+            if float(y32[at]) < self._top_best_y:
+                self._top_best_y = float(y32[at])
+                self._top_best_idx = prev_count + int(at)
+
+    def _maybe_prewarm(self, batch=0):
+        # Shared trigger (tpu_bo.maybe_prewarm_fused_step): fidelity rides
+        # along as the fixed context column via _step_kw's fixed_tail_cols.
+        maybe_prewarm_fused_step(self, batch=batch)
 
     # --- model-based sampling -----------------------------------------------
-    def _new_cube(self, num):
-        n = self._mf_x.shape[0]
-        if n < self.n_init:
-            return super()._new_cube(num)
-        if self.trust_region:
-            # Global argmin: early TR rounds have almost nothing at the top
-            # tier, and the s-lengthscale already decides how much to trust
-            # low-fidelity values — the incumbent just centers the box.
-            best_row = int(np.argmin(self._mf_y))
-        else:
-            # Best observation at the highest observed fidelity tier.
-            top = self._mf_s >= self._mf_s.max() - 1e-6
-            pool_idx = np.nonzero(top)[0]
-            best_row = pool_idx[int(np.argmin(self._mf_y[pool_idx]))]
-        best_x = self._mf_x[best_row]
-        step_kw = dict(
+    def _step_kw(self):
+        return dict(
             n_candidates=self.n_candidates,
             kernel=self.kernel,
             acq=self.acq,
@@ -258,42 +311,51 @@ class ASHABO(ASHA):
             trust_region=self.trust_region,
             tr_length=self._tr_length,
             tr_perturb_dims=self.tr_perturb_dims,
+            y_transform=self.y_transform,
             # Fidelity is context, pinned to s=1 when scoring: selection
             # optimizes predicted FULL-budget value; the rung machinery then
             # assigns the actual bottom-rung fidelity.
             fixed_tail_cols=1,
             mesh=self._mesh,
         )
+
+    def _new_cube(self, num):
+        n = self._host.count
+        if n < self.n_init:
+            return super()._new_cube(num)
+        self._last_q_bucket = _next_pow2(num, floor=8)
+        if self.trust_region:
+            # Global argmin: early TR rounds have almost nothing at the top
+            # tier, and the s-lengthscale already decides how much to trust
+            # low-fidelity values — the incumbent just centers the box.
+            # O(1): tracked incrementally by HostHistory.
+            best_row = self._host.best_idx
+        else:
+            # Best observation at the highest observed fidelity tier —
+            # O(1) via the incremental tracker (see _update_top_tier).
+            best_row = self._top_best_idx
+        d = self.space.n_cols
+        best_x = self._host.x[best_row, :d]
+        step_kw = self._step_kw()
         if self.trust_region and n > self.tr_local_m:
             # Local GP on the nearest observations (x-distance, fidelity
-            # ignored): keeps lengthscales local, Cholesky small.  Fresh
-            # host-side gather (bounded by tr_local_m) — keeps the upload.
-            idx = local_subset_indices(self._mf_x, best_x, self.tr_local_m)
-            x_sel, s_sel, y_raw = (
-                self._mf_x[idx], self._mf_s[idx], self._mf_y[idx]
-            )
-            y_fit = (
-                copula_transform(y_raw) if self.y_transform == "copula" else y_raw
-            )
-            # Augmented inputs [x | s]; the fused step pads/buckets internally.
-            x_aug = np.concatenate([x_sel, s_sel[:, None]], axis=1)
-            rows, state = run_suggest_step(
-                self.next_key(), x_aug, y_fit, best_x, self._gp_state, num,
-                **step_kw,
+            # ignored): keeps lengthscales local, Cholesky small.  The
+            # subset is gathered ON DEVICE from the resident augmented
+            # buffers (dist_cols=d skips the s column) — no host distance
+            # scan, gather, or upload.
+            x_dev, y_dev, mask_dev, _ = self._hist.local_view(
+                self._host.x[best_row], self.tr_local_m, dist_cols=d
             )
         else:
-            # Device-resident fast path: the augmented history already lives
-            # on device; only the (rank-global) copula y, if enabled, is
-            # rebuilt and shipped per round.
-            x_dev, y_dev, mask_dev, m = self._hist.fit_view()
-            if self.y_transform == "copula":
-                y_pad = np.zeros((m,), dtype=np.float32)
-                y_pad[:n] = copula_transform(self._mf_y)
-                y_dev = jnp.asarray(y_pad)
-            rows, state = run_suggest_step_arrays(
-                self.next_key(), x_dev, y_dev, mask_dev, best_x,
-                self._gp_state, num, **step_kw,
-            )
+            # Full-history fast path: the augmented history already lives
+            # on device, and the (rank-global) copula transform, when
+            # enabled, runs in-jit — nothing history-sized is rebuilt on
+            # host or shipped per round.
+            x_dev, y_dev, mask_dev, _ = self._hist.fit_view()
+        rows, state = run_suggest_step_arrays(
+            self.next_key(), x_dev, y_dev, mask_dev, best_x,
+            self._gp_state, num, prewarmer=self._prewarmer, **step_kw,
+        )
         self._gp_state = state
         return rows
 
@@ -313,14 +375,20 @@ class ASHABO(ASHA):
     def set_state(self, state):
         super().set_state(state)
         d = self.space.n_cols
-        self._mf_x = np.asarray(state.get("mf_x", []), dtype=np.float32).reshape(-1, d)
-        self._mf_s = np.asarray(state.get("mf_s", []), dtype=np.float32)
-        self._mf_y = np.asarray(state.get("mf_y", []), dtype=np.float32)
-        # Rebuild the device-resident augmented history with one bulk upload.
-        self._hist = DeviceHistory.from_host(
-            np.concatenate([self._mf_x, self._mf_s[:, None]], axis=1),
-            self._mf_y,
-        )
+        mf_x = np.asarray(state.get("mf_x", []), dtype=np.float32).reshape(-1, d)
+        mf_s = np.asarray(state.get("mf_s", []), dtype=np.float32)
+        mf_y = np.asarray(state.get("mf_y", []), dtype=np.float32)
+        aug = np.concatenate([mf_x, mf_s[:, None]], axis=1)
+        # Rebuild host (incumbent tracking resumes) and the device-resident
+        # augmented history with one bulk upload each.
+        self._host = HostHistory.from_host(aug, mf_y)
+        self._hist = DeviceHistory.from_host(aug, mf_y)
+        # Rebuild the top-tier incumbent tracker from scratch.
+        self._s_top = -np.inf
+        self._top_best_idx = -1
+        self._top_best_y = np.inf
+        if mf_s.size:
+            self._update_top_tier(0, mf_s, mf_y)
         self._sigma = state.get("sigma", self.local_sigma)
         best = state.get("best_seen")
         self._best_seen = np.inf if best is None else float(best)

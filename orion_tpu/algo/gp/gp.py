@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import optax
 
 from orion_tpu.algo.gp.kernels import cross_kernel_matrix, kernel_matrix
+from orion_tpu.algo.sampling import masked_copula_transform
 
 JITTER = 1e-5
 
@@ -82,13 +83,21 @@ def _neg_mll(hypers, kind, x, y_norm, mask):
     return 0.5 * (quad + logdet) / n
 
 
-@partial(jax.jit, static_argnames=("kind", "n_steps"))
-def fit_gp(x, y, mask, kind="matern52", n_steps=50, lr=0.08, init=None):
+@partial(jax.jit, static_argnames=("kind", "n_steps", "y_transform"))
+def fit_gp(x, y, mask, kind="matern52", n_steps=50, lr=0.08, init=None,
+           y_transform="none"):
     """Fit hyperparameters by adam on the marginal likelihood; returns GPState
-    with the posterior factorization cached (Cholesky + alpha)."""
+    with the posterior factorization cached (Cholesky + alpha).
+
+    ``y_transform="copula"`` rank-Gaussianizes the masked targets ON DEVICE
+    before normalization (see ``sampling.masked_copula_transform``); the
+    returned ``GPState.y`` then holds the transformed targets, exactly as
+    when callers pre-transformed on host."""
     x = x.astype(jnp.float32)
     y = y.astype(jnp.float32)
     mask = mask.astype(jnp.float32)
+    if y_transform == "copula":
+        y = masked_copula_transform(y, mask)
     y_norm, y_mean, y_std = _normalize_y(y, mask)
     hypers = init if init is not None else init_hypers(x.shape[1])
 

@@ -1,4 +1,6 @@
-"""Device-resident observation history with incremental in-place appends.
+"""Observation history buffers: device-resident pow-2 buffers with
+incremental in-place appends (:class:`DeviceHistory`) and their
+amortized-growth host twin (:class:`HostHistory`).
 
 The GP algorithms (`tpu_bo`, `asha_bo`) fit on the full observation history
 every round.  Re-padding that history on host and re-uploading it with
@@ -57,6 +59,46 @@ def _donation_supported():
     # tests run JAX_PLATFORMS=cpu).  Accelerator backends — including this
     # image's remote tunnel — take the alias.
     return jax.default_backend() != "cpu"
+
+
+@partial(jax.jit, static_argnames=("m", "m_pad", "dist_cols"))
+def _local_subset(x, y, mask, center, m, m_pad, dist_cols):
+    """Gather the ``m`` nearest real rows to ``center`` (squared euclidean
+    over the leading ``dist_cols`` columns), padded to ``m_pad`` — the
+    device twin of the old host ``np.argpartition`` local-GP selection.
+    Ties break by lowest index (``top_k``), deterministically."""
+    d2 = jnp.sum((x[:, :dist_cols] - center[None, :dist_cols]) ** 2, axis=1)
+    d2 = jnp.where(mask > 0, d2, jnp.inf)
+    _, idx = jax.lax.top_k(-d2, m)
+    xs = jnp.take(x, idx, axis=0)
+    ys = jnp.take(y, idx)
+    ms = jnp.ones((m,), x.dtype)
+    if m_pad > m:
+        xs = jnp.pad(xs, ((0, m_pad - m), (0, 0)))
+        ys = jnp.pad(ys, (0, m_pad - m))
+        ms = jnp.pad(ms, (0, m_pad - m))
+    return xs, ys, ms
+
+
+def prewarm_local_subset(m_hist, n_cols, m, dist_cols, floor=64):
+    """Compile the device local-subset gather for the ``(m_hist, n_cols)``
+    history bucket by calling it on zero dummies (populates the jit
+    cache).  In the local-TR regime the fused step's fit shape is pinned,
+    but this gather still re-buckets with the history — without a warm it
+    would pay a (small) synchronous compile at every pow-2 growth."""
+    x = jnp.zeros((int(m_hist), int(n_cols)), jnp.float32)
+    # No block_until_ready — the compile (and with it the jit-cache
+    # insert) completes synchronously before the call returns; see
+    # tpu_bo.prewarm_suggest_step.
+    _local_subset(
+        x,
+        x[:, 0],
+        x[:, 0],
+        jnp.zeros((int(n_cols),), jnp.float32),
+        m=int(m),
+        m_pad=_next_pow2(int(m), floor=floor),
+        dist_cols=int(dist_cols),
+    )
 
 
 def _append_impl(x, y, mask, rows, ys, mvals, n):
@@ -185,3 +227,114 @@ class DeviceHistory:
         if m == self.cap:
             return self._x, self._y, self._mask, m
         return self._x[:m], self._y[:m], self._mask[:m], m
+
+    def local_view(self, center, m, dist_cols=None):
+        """``(x, y, mask, m_pad)`` of the ``m`` rows nearest to ``center``
+        (x-distance over the leading ``dist_cols`` columns; default all),
+        gathered ON DEVICE from the resident buffers and padded to
+        ``m_pad = _next_pow2(m)`` — the local-GP (TuRBO subset) fit set
+        without the O(n·d) host distance scan, host gather, or re-upload
+        the old ``np.argpartition`` path paid per suggest.  Only ``center``
+        (one row) crosses the boundary.  Requires ``count >= m``."""
+        m = int(m)
+        x, y, mask, _ = self.fit_view()
+        xs, ys, ms = _local_subset(
+            x,
+            y,
+            mask,
+            jnp.asarray(np.asarray(center, dtype=np.float32)),
+            m=m,
+            m_pad=_next_pow2(m, floor=self.floor),
+            dist_cols=int(dist_cols) if dist_cols is not None else self.n_cols,
+        )
+        return xs, ys, ms, _next_pow2(m, floor=self.floor)
+
+
+class HostHistory:
+    """Amortized-growth host mirrors ``(x, y)`` with O(batch) appends.
+
+    The old mirrors were rebuilt by ``np.concatenate`` per observe — an
+    O(n) copy per round, O(n²) cumulative.  This keeps capacity-doubling
+    numpy buffers written in place at ``count``, so a steady-state observe
+    costs O(batch) host work; ``x``/``y`` are zero-copy views sliced to
+    ``count`` (bit-identical to what the concatenate path held — pinned in
+    ``tests/unit/test_host_history.py``).
+
+    The incumbent is tracked incrementally: ``best_idx``/``best_y`` are
+    the FIRST-occurrence argmin/min over the history (exactly what
+    ``np.argmin`` returns), updated in O(batch) per append — no O(n)
+    argmin scan per suggest.
+
+    Naive-copy discipline mirrors :class:`DeviceHistory`: ``__deepcopy__``
+    shares the buffers and marks both sides copy-on-write, so a lie
+    clone's fantasy rows can never clobber (or be clobbered by) the real
+    history — the first append on either side after a clone copies its
+    rows into fresh exclusively-owned buffers (one memcpy, the same cost
+    the old concatenate paid every round)."""
+
+    def __init__(self, n_cols, floor=64):
+        self.n_cols = int(n_cols)
+        self.floor = max(int(floor), 1)
+        self.count = 0
+        self._x = np.zeros((self.floor, self.n_cols), dtype=np.float32)
+        self._y = np.zeros((self.floor,), dtype=np.float32)
+        self._cow = False
+        self.best_idx = -1
+        self.best_y = np.inf
+
+    @classmethod
+    def from_host(cls, x, y, floor=64):
+        """Bulk-build from materialized arrays (state restore / resume)."""
+        x = np.asarray(x, dtype=np.float32)
+        hist = cls(x.shape[1] if x.ndim == 2 else 0, floor=floor)
+        if x.shape[0]:
+            hist.append(x, np.asarray(y, dtype=np.float32))
+        return hist
+
+    @property
+    def x(self):
+        """(count, n_cols) view — rows [:count] are never mutated in place."""
+        return self._x[: self.count]
+
+    @property
+    def y(self):
+        return self._y[: self.count]
+
+    def __deepcopy__(self, memo):
+        clone = HostHistory.__new__(HostHistory)
+        clone.__dict__.update(self.__dict__)
+        clone._cow = True
+        self._cow = True
+        memo[id(self)] = clone
+        return clone
+
+    def _own_with_capacity(self, need):
+        """Exclusively-owned buffers covering ``need`` rows (grow and/or
+        copy-on-write in one memcpy)."""
+        cap = self._x.shape[0]
+        new_cap = _next_pow2(need, floor=cap)  # cap is always a pow-2
+        if new_cap == cap and not self._cow:
+            return
+        x = np.zeros((new_cap, self.n_cols), dtype=np.float32)
+        y = np.zeros((new_cap,), dtype=np.float32)
+        x[: self.count] = self._x[: self.count]
+        y[: self.count] = self._y[: self.count]
+        self._x, self._y = x, y
+        self._cow = False
+
+    def append(self, rows, ys):
+        rows = np.asarray(rows, dtype=np.float32).reshape(-1, self.n_cols)
+        ys = np.asarray(ys, dtype=np.float32).reshape(-1)
+        b = rows.shape[0]
+        if b == 0:
+            return
+        self._own_with_capacity(self.count + b)
+        self._x[self.count : self.count + b] = rows
+        self._y[self.count : self.count + b] = ys
+        batch_arg = int(np.argmin(ys))
+        # Strict <: ties keep the earliest index, matching np.argmin over
+        # the full concatenated history.
+        if float(ys[batch_arg]) < self.best_y:
+            self.best_y = float(ys[batch_arg])
+            self.best_idx = self.count + batch_arg
+        self.count += b

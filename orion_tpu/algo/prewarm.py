@@ -1,0 +1,187 @@
+"""Asynchronous pow-2 shape-bucket prewarming for the fused suggest step.
+
+The GP history lives in power-of-2-padded buffers: when the observation
+count crosses a bucket boundary (64 -> 65 means the fit shape jumps 64 ->
+128), the fused suggest jit sees a new input shape and pays a synchronous
+trace+lower+compile — a multi-second dispatch stall in the middle of a run,
+exactly the cliff the fused-step design otherwise avoids.
+
+The fix is to compile the NEXT bucket before the history gets there: when
+``count`` nears the boundary (``prewarm_fill`` of the current bucket), the
+algorithm hands a zero-arg compile closure — a dummy call of the jitted
+step at the next bucket's exact shapes and static-arg signature — to a
+:class:`BucketPrewarmer`, which runs it on a background daemon thread.
+Calling the jitted function itself (rather than AOT ``lower().compile()``,
+which would NOT populate the jit call cache) makes the eventual real call a
+cache hit.  XLA compilation releases the GIL, so the main thread keeps
+producing rounds while the compile runs.
+
+Honest accounting: prewarm compiles are counted under the ``jax.prewarms``
+telemetry counter and the ``jax.prewarm.compile`` span — NEVER under
+``jax.retraces``, which keeps counting only the synchronous retraces a
+suggest call actually paid (the channel the boundary-crossing test and
+``orion-tpu info`` read).
+"""
+
+import logging
+import threading
+import time
+
+from orion_tpu.telemetry import TELEMETRY
+
+log = logging.getLogger(__name__)
+
+#: Fraction of the current bucket the history must fill before the next
+#: bucket's compile is kicked off (early enough that multi-second compiles
+#: finish before the crossing, late enough not to warm buckets short runs
+#: never reach).
+DEFAULT_PREWARM_FILL = 0.75
+
+# Process-wide prewarm activity, sampled by the retrace detector in
+# run_suggest_step_arrays (as a fallback when no per-instance prewarmer is
+# passed): a jit-cache growth observed in a window where this count moved
+# came from a background prewarm landing, not from a synchronous retrace
+# the suggest paid.  The compile is synchronous inside the prewarm's
+# jitted dummy call and this bookkeeping follows within microseconds (the
+# dummy's async execution is NOT waited on), so the delta tightly brackets
+# the cache insert.
+_completed_lock = threading.Lock()
+_completed_count = 0
+
+
+def completed_prewarm_count():
+    """Monotonic count of finished prewarm compile attempts (success or
+    failure — either may have inserted a jit-cache entry)."""
+    with _completed_lock:
+        return _completed_count
+
+
+def _note_prewarm_completed():
+    global _completed_count
+    with _completed_lock:
+        _completed_count += 1
+
+
+def plan_next_bucket(count, *, floor, fill=DEFAULT_PREWARM_FILL, batch=0,
+                     next_pow2=None):
+    """The bucket worth prewarming for a history at ``count`` rows, or None.
+
+    Two triggers, whichever fires first:
+
+    - **batch anticipation**: if one more observe of the size just seen
+      (``batch``) would cross the current bucket, warm the bucket that
+      observe LANDS in (``next_pow2(count + batch)`` — possibly several
+      buckets ahead: a q=1024 round at bucket 2048 jumps straight to
+      4096).  Without this, any batch larger than ``(1-fill) * bucket``
+      steps over the fill window and the crossing pays the compile anyway.
+    - **fill**: the current bucket is at least ``fill`` full — covers
+      drifting/small arrival sizes.
+
+    Pure planning — callers decide which jit signature that shape feeds
+    (full-history vs local-subset paths differ; a path whose fit shape is
+    pinned, like the subset pad, has nothing to prewarm at history
+    boundaries)."""
+    if next_pow2 is None:
+        from orion_tpu.algo.history import _next_pow2 as next_pow2
+    if count <= 0:
+        return None
+    m = next_pow2(count, floor=floor)
+    if batch and count + batch > m:
+        return next_pow2(count + batch, floor=floor)
+    if count < fill * m:
+        return None
+    return 2 * m
+
+
+def plan_fused_step_bucket(count, *, floor, fill=DEFAULT_PREWARM_FILL,
+                           batch=0, trust_region=False, tr_local_m=None):
+    """Target fit shape for the GP algorithms' fused suggest step, or None.
+
+    Folds in the local-subset switch: once the history is past
+    ``tr_local_m`` the FUSED STEP's fit shape is pinned at
+    ``next_pow2(tr_local_m)`` — no fused-step boundary left to warm (the
+    small local-subset gather jit still re-buckets with the history; the
+    trigger warms it separately).  A crossing that LANDS past the switch
+    targets the subset pad instead of the raw next bucket — unless that
+    pad is at most the current fit shape, which every suggest since the
+    last boundary already compiled: warming it again would be a no-op
+    that still books a ``jax.prewarms`` count."""
+    from orion_tpu.algo.history import _next_pow2
+
+    if trust_region and tr_local_m is not None and count > tr_local_m:
+        return None
+    target = plan_next_bucket(count, floor=floor, fill=fill, batch=batch)
+    if target is None:
+        return None
+    if trust_region and tr_local_m is not None and target > tr_local_m:
+        target = _next_pow2(tr_local_m, floor=floor)
+        if target <= _next_pow2(count, floor=floor):
+            return None  # the current fit shape — already compiled
+    return target
+
+
+class BucketPrewarmer:
+    """Deduplicated background compile runner.
+
+    One instance per algorithm (shared by-ref with its naive copies — the
+    jit cache is process-wide, so warming once covers every clone).  Each
+    distinct signature key compiles at most once; failures are logged and
+    swallowed (a failed prewarm just means the boundary pays the compile it
+    would have paid anyway)."""
+
+    def __init__(self):
+        self._started = set()
+        self._threads = {}
+        self._lock = threading.Lock()
+        self._completed = 0
+
+    def maybe_start(self, key, compile_fn):
+        """Run ``compile_fn`` on a background thread unless ``key`` was
+        already started.  Returns True when a new prewarm was launched."""
+        with self._lock:
+            if key in self._started:
+                return False
+            self._started.add(key)
+            thread = threading.Thread(
+                target=self._run,
+                args=(key, compile_fn),
+                name="orion-tpu-prewarm",
+                daemon=True,
+            )
+            self._threads[key] = thread
+        thread.start()
+        return True
+
+    def _run(self, key, compile_fn):
+        t0 = time.perf_counter()
+        try:
+            compile_fn()
+        except Exception:  # never raise out of a daemon thread
+            log.debug("prewarm compile failed for %r", key, exc_info=True)
+            return
+        finally:
+            _note_prewarm_completed()
+            with self._lock:
+                self._completed += 1
+        TELEMETRY.count("jax.prewarms")
+        TELEMETRY.record_span("jax.prewarm.compile", start=t0)
+
+    def completed_count(self):
+        """Prewarm attempts THIS instance finished (success or failure) —
+        the per-algorithm twin of :func:`completed_prewarm_count`, so the
+        retrace detector can scope its discount to the one prewarmer whose
+        compiles share the caller's jit signatures instead of being
+        blinded by unrelated instances' warms."""
+        with self._lock:
+            return self._completed
+
+    def wait(self, timeout=None):
+        """Join every launched prewarm thread (tests / deterministic
+        boundary crossings).  ``timeout`` is per-thread."""
+        for thread in list(self._threads.values()):
+            thread.join(timeout)
+
+    @property
+    def in_flight(self):
+        """True while any prewarm compile is still running."""
+        return any(t.is_alive() for t in self._threads.values())

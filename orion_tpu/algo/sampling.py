@@ -15,6 +15,28 @@ def reflect_unit(x):
     return jnp.where(r > 1.0, 2.0 - r, r)
 
 
+def masked_copula_transform(y, mask):
+    """Rank -> normal-quantile (copula) transform over the masked rows,
+    entirely on device — the in-jit twin of ``tpu_bo.copula_transform``.
+
+    Real rows (mask 1) get rank r in first-occurrence order (stable
+    argsort, matching the host path's ``kind="stable"``) and map to
+    ``ndtri((r + 0.5) / n)``; padded rows sort last (key +inf) and come
+    back exactly 0.0, preserving the all-zeros-past-count buffer
+    invariant.  Monotone, so the argmin row is preserved.  Running this
+    inside the fused suggest step removes the per-round O(n) host
+    transform and the (n_pad,) y re-upload — the ranks change globally
+    with every observation, but the device already holds y."""
+    from jax.scipy.special import ndtri
+
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    keyed = jnp.where(mask > 0, y, jnp.inf)
+    rank = jnp.argsort(jnp.argsort(keyed))  # jnp.argsort is stable
+    q = (rank.astype(jnp.float32) + 0.5) / n
+    out = ndtri(jnp.clip(q, 1e-7, 1.0 - 1e-7))
+    return jnp.where(mask > 0, out, 0.0).astype(jnp.float32)
+
+
 def clamp_objectives(objectives, history):
     """Replace non-finite objectives with the worst finite value known.
 

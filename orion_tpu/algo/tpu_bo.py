@@ -30,24 +30,47 @@ from orion_tpu.algo.gp.acquisition import (
     select_q,
 )
 from orion_tpu.algo.gp.gp import fit_gp, init_hypers, posterior_norm
-from orion_tpu.algo.history import DeviceHistory, _next_pow2
+from orion_tpu.algo.history import (
+    DeviceHistory,
+    HostHistory,
+    _next_pow2,
+    prewarm_local_subset,
+)
+from orion_tpu.algo.prewarm import (
+    DEFAULT_PREWARM_FILL,
+    BucketPrewarmer,
+    completed_prewarm_count,
+    plan_fused_step_bucket,
+    plan_next_bucket,
+)
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
 from orion_tpu.parallel import candidate_sharding, device_mesh
 
 
 def copula_transform(y):
     """Rank -> normal quantile on host (monotone: argmin preserved).
-    O(n log n) over a few thousand floats per round, noise next to the
-    device dispatch.  Shared by tpu_bo and asha_bo so the y-transform
-    semantics cannot diverge."""
+
+    The HOT path no longer runs this: the fused suggest step applies the
+    same transform on device (``sampling.masked_copula_transform``, routed
+    through ``fit_gp(y_transform="copula")``), so the full-history y is
+    never re-ranked on host or re-uploaded per round.  This host twin
+    remains the parity reference (``tests/unit/test_copula_device.py``
+    pins device == host within float32 tolerance) and the entry point for
+    host-side consumers.  The inner sort is ``kind="stable"`` so duplicate
+    objectives get first-occurrence ranks — the tie order jax's (stable)
+    ``argsort`` uses on device."""
     from scipy.special import ndtri
 
-    order = np.argsort(np.argsort(y))
+    order = np.argsort(np.argsort(y, kind="stable"))
     return ndtri((order + 0.5) / y.shape[0]).astype(np.float32)
 
 
 def local_subset_indices(x, center, m):
-    """Indices of the m nearest rows to ``center`` (local-GP selection)."""
+    """Indices of the m nearest rows to ``center`` (local-GP selection).
+
+    Host reference implementation; the algorithms now gather the subset on
+    device (``DeviceHistory.local_view``) so the fit set never crosses the
+    host boundary."""
     d2 = ((x - center[None, :]) ** 2).sum(axis=1)
     return np.argpartition(d2, m)[:m]
 
@@ -146,6 +169,12 @@ class TPUBO(BaseAlgorithm):
         is what lets the GP concentrate samples inside high-D curved
         valleys (Rosenbrock-class landscapes) where a global-uniform +
         fixed-sigma-ball scheme plateaus.
+    prewarm: background-compile the next pow-2 history bucket's fused
+        suggest step before the history crosses the boundary, so mid-run
+        bucket growth costs a jit-cache hit instead of a synchronous
+        multi-second compile stall (docs/performance.md, "The
+        zero-reupload round").  ``prewarm_fill`` is the bucket-fill
+        fraction that triggers the compile (default 0.75).
     tr_update_every: the box adaptation cadence in *observations*, not
         rounds — an observe round larger than this is split into
         sequential sub-rounds for the TuRBO schedule (tr_update_batch),
@@ -181,6 +210,8 @@ class TPUBO(BaseAlgorithm):
         tr_perturb_dims=20,
         tr_update_every=8,
         speculative_suggest=False,
+        prewarm=True,
+        prewarm_fill=DEFAULT_PREWARM_FILL,
         n_devices=None,
         use_mesh=False,
     ):
@@ -208,6 +239,8 @@ class TPUBO(BaseAlgorithm):
             tr_perturb_dims=tr_perturb_dims,
             tr_update_every=tr_update_every,
             speculative_suggest=speculative_suggest,
+            prewarm=prewarm,
+            prewarm_fill=prewarm_fill,
         )
         self.n_init = n_init
         self.n_candidates = n_candidates
@@ -237,19 +270,29 @@ class TPUBO(BaseAlgorithm):
         # one-round-stale conditioning cost every async multi-worker setup
         # already accepts (measured on Hartmann6: regret 0.13 -> 0.21).
         self.speculation_safe = bool(speculative_suggest)
+        self.prewarm = bool(prewarm)
+        self.prewarm_fill = float(prewarm_fill)
         self.use_mesh = use_mesh
         self._mesh = device_mesh(n_devices) if use_mesh else None
         d = space.n_cols
-        self._x = np.zeros((0, d), dtype=np.float32)
-        self._y = np.zeros((0,), dtype=np.float32)
-        # Device-resident twin of (_x, _y): incrementally appended on
-        # observe so the full-history suggest path never re-uploads rows
-        # the device already holds (docs/algorithms.md, "Device-resident
-        # history").  The host mirrors stay the source of truth for
-        # trust-region bookkeeping, local-subset selection, the copula
-        # transform, and state_dict.
+        # Host history: amortized-growth capped buffers with O(batch)
+        # appends and an incrementally-tracked incumbent — the old
+        # np.concatenate mirrors cost O(n) host work per observe.  Only
+        # bookkeeping that genuinely needs host floats reads it
+        # (trust-region schedule, restart-center scans, state_dict).
+        self._host = HostHistory(d)
+        # Device-resident twin: incrementally appended on observe so the
+        # suggest path never re-uploads rows the device already holds
+        # (docs/algorithms.md, "Device-resident history").  The copula
+        # y-transform and local-subset selection run on these buffers
+        # in-jit, so a steady-state round's upload is O(batch) rows.
         self._hist = DeviceHistory(d)
         self._gp_state = None
+        # Shape-bucket AOT prewarm: compiles the next pow-2 bucket's fused
+        # step on a background thread before the history crosses the
+        # boundary (docs/performance.md, "The zero-reupload round").
+        self._prewarmer = BucketPrewarmer()
+        self._last_q_bucket = None
         self._tr_length = tr_length_init
         self._tr_succ = 0
         self._tr_fail = 0
@@ -257,27 +300,39 @@ class TPUBO(BaseAlgorithm):
         # collapse with no progress (None = the global incumbent).
         self._tr_center = None
 
-    # Naive-copy sharing (base __deepcopy__): the mesh handle is not
-    # copyable and the fitted GP state / observation buffers are
-    # immutable-by-rebinding.  `_hist` is deliberately NOT here: its own
-    # __deepcopy__ implements copy-on-write sharing of the device buffers
-    # (a plain by-ref share would let the clone's donated in-place appends
-    # clobber the real algorithm's history).
-    _share_by_ref = ("space", "_mesh", "_gp_state", "_x", "_y")
+    # Naive-copy sharing (base __deepcopy__): the mesh handle and the
+    # prewarmer's threads/locks are not copyable (and the jit cache they
+    # warm is process-wide — one warm covers every clone); the fitted GP
+    # state is immutable-by-rebinding.  `_hist` and `_host` are
+    # deliberately NOT here: their own __deepcopy__ implements
+    # copy-on-write sharing of the buffers (a plain by-ref share would let
+    # the clone's in-place appends clobber the real algorithm's history).
+    _share_by_ref = ("space", "_mesh", "_gp_state", "_prewarmer")
+
+    # Back-compat views of the observation history (tests and host-side
+    # consumers read these; appends go through `_host`).
+    @property
+    def _x(self):
+        return self._host.x
+
+    @property
+    def _y(self):
+        return self._host.y
 
     # --- observation --------------------------------------------------------
     def observe_arrays(self, cube, objectives, params_list=None, fidelities=None):
         objectives = clamp_objectives(objectives, self._y)
         if objectives is None:
             return
-        prev_n = self._y.shape[0]
-        prev_best = float(np.min(self._y)) if prev_n else np.inf
+        prev_n = self._host.count
+        prev_best = self._host.best_y  # O(1): tracked incrementally
         rows32 = np.asarray(cube, dtype=np.float32)
         y32 = np.asarray(objectives, dtype=np.float32)
-        self._x = np.concatenate([self._x, rows32])
-        self._y = np.concatenate([self._y, y32])
-        # Incremental device append: only the new rows cross the boundary.
+        # O(batch) host append + O(batch) device append: only the new rows
+        # cross the boundary, and no O(n) concatenate/argmin runs on host.
+        self._host.append(rows32, y32)
         self._hist.append(rows32, y32)
+        self._maybe_prewarm(batch=y32.shape[0])
         # Trust-region bookkeeping counts MODEL rounds only: observations of
         # the random init phase say nothing about the local model's quality.
         if self.trust_region and prev_n >= self.n_init:
@@ -294,7 +349,7 @@ class TPUBO(BaseAlgorithm):
                 length_max=self.tr_length_max,
                 improve_tol=self.tr_improve_tol,
             )
-            new_best = float(np.min(self._y))
+            new_best = self._host.best_y
             if new_best < prev_best - self.tr_improve_tol * abs(prev_best):
                 # Progress: the box belongs back on the true incumbent.
                 self._tr_center = None
@@ -310,8 +365,10 @@ class TPUBO(BaseAlgorithm):
     def _fresh_restart_center(self):
         """Index of the best observation usefully FAR from the incumbent
         (>= a quarter of the mean distance to it); None when nothing
-        qualifies (early runs whose points all cluster)."""
-        best_idx = int(np.argmin(self._y))
+        qualifies (early runs whose points all cluster).  The O(n) distance
+        scan only runs on a box collapse without progress — a rare event,
+        not steady-state observe cost."""
+        best_idx = self._host.best_idx
         d = np.sqrt(((self._x - self._x[best_idx]) ** 2).sum(axis=1))
         far = d >= max(float(d.mean()) / 4.0, 1e-6)
         if not far.any():
@@ -320,23 +377,8 @@ class TPUBO(BaseAlgorithm):
         return int(candidates[np.argmin(self._y[candidates])])
 
     # --- suggestion ---------------------------------------------------------
-    def _suggest_cube(self, num):
-        n = self._x.shape[0]
-        if n < self.n_init:
-            return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
-        # Single fused jit call: warm-started GP refit + candidate generation
-        # + acquisition + on-device dedup/EI-fill + gather.  One dispatch and
-        # one (q, d) transfer per suggest — dispatch latency otherwise
-        # dominates (each host->device round trip costs ~ms).  With a mesh,
-        # the same compiled step shards the candidate axis over it (SPMD
-        # collectives inserted by XLA, see orion_tpu.parallel).
-        center_idx = (
-            self._tr_center
-            if self._tr_center is not None and self._tr_center < n
-            else int(np.argmin(self._y))
-        )
-        best_x = self._x[center_idx]
-        step_kw = dict(
+    def _step_kw(self):
+        return dict(
             n_candidates=self.n_candidates,
             kernel=self.kernel,
             acq=self.acq,
@@ -348,40 +390,56 @@ class TPUBO(BaseAlgorithm):
             trust_region=self.trust_region,
             tr_length=self._tr_length,
             tr_perturb_dims=self.tr_perturb_dims,
+            y_transform=self.y_transform,
             mesh=self._mesh,
         )
-        if self.trust_region and self._x.shape[0] > self.tr_local_m:
+
+    def _maybe_prewarm(self, batch=0):
+        maybe_prewarm_fused_step(self, batch=batch)
+
+    def _suggest_cube(self, num):
+        n = self._host.count
+        if n < self.n_init:
+            return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
+        # Single fused jit call: warm-started GP refit + on-device copula
+        # y-transform + candidate generation + acquisition + on-device
+        # dedup/EI-fill + gather.  One dispatch and one (q, d) transfer per
+        # suggest — dispatch latency otherwise dominates (each host->device
+        # round trip costs ~ms).  With a mesh, the same compiled step
+        # shards the candidate axis over it (SPMD collectives inserted by
+        # XLA, see orion_tpu.parallel).
+        self._last_q_bucket = _next_pow2(num, floor=8)
+        center_idx = (
+            self._tr_center
+            if self._tr_center is not None and self._tr_center < n
+            else self._host.best_idx  # O(1): tracked incrementally
+        )
+        best_x = self._host.x[center_idx]
+        step_kw = self._step_kw()
+        if self.trust_region and n > self.tr_local_m:
             # LOCAL GP (the TuRBO design): fit only the tr_local_m nearest
             # observations to the incumbent.  A global fit has to average
             # lengthscales over the whole landscape, washing out exactly the
             # local structure the trust region is trying to exploit — and a
             # 4x smaller buffer makes the per-round Cholesky ~64x cheaper.
-            # The fit set is a fresh host-side gather (bounded by
-            # tr_local_m, not O(n)), so this path keeps the host upload.
-            idx = local_subset_indices(self._x, best_x, self.tr_local_m)
-            x_fit, y_raw = self._x[idx], self._y[idx]
-            y_fit = (
-                copula_transform(y_raw) if self.y_transform == "copula" else y_raw
-            )
-            rows, state = run_suggest_step(
-                self.next_key(), x_fit, y_fit, best_x, self._gp_state, num,
-                **step_kw,
+            # The subset is gathered ON DEVICE from the resident buffers
+            # (masked top_k, DeviceHistory.local_view): no O(n·d) host
+            # distance scan, no host gather, no upload — only the center
+            # row crosses the boundary.
+            x_dev, y_dev, mask_dev, _ = self._hist.local_view(
+                best_x, self.tr_local_m
             )
         else:
-            # Device-resident fast path: the fit set IS the full history,
+            # Full-history fast path: the fit set IS the full history,
             # which already lives on device — no O(n) re-pad or re-upload.
-            # Only the copula-transformed y (whose ranks change globally
-            # with every new observation) is rebuilt on host and shipped,
-            # an O(n) vector next to the O(n·d) x re-upload this replaces.
-            x_dev, y_dev, mask_dev, m = self._hist.fit_view()
-            if self.y_transform == "copula":
-                y_pad = np.zeros((m,), dtype=np.float32)
-                y_pad[:n] = copula_transform(self._y)
-                y_dev = jnp.asarray(y_pad)
-            rows, state = run_suggest_step_arrays(
-                self.next_key(), x_dev, y_dev, mask_dev, best_x,
-                self._gp_state, num, **step_kw,
-            )
+            # The copula transform (whose ranks change globally with every
+            # new observation) runs in-jit over the masked device y, so
+            # nothing history-sized crosses the boundary here either.
+            x_dev, y_dev, mask_dev, _ = self._hist.fit_view()
+        rows, state = run_suggest_step_arrays(
+            self.next_key(), x_dev, y_dev, mask_dev, best_x,
+            self._gp_state, num, prewarmer=self._prewarmer, **step_kw,
+        )
         self._gp_state = state
         return rows
 
@@ -397,11 +455,13 @@ class TPUBO(BaseAlgorithm):
     def set_state(self, state):
         super().set_state(state)
         d = self.space.n_cols
-        self._x = np.asarray(state["x"], dtype=np.float32).reshape(-1, d)
-        self._y = np.asarray(state["y"], dtype=np.float32)
-        # Rebuild the device-resident twin with ONE bulk upload; incremental
-        # appends resume from here.
-        self._hist = DeviceHistory.from_host(self._x, self._y)
+        x = np.asarray(state["x"], dtype=np.float32).reshape(-1, d)
+        y = np.asarray(state["y"], dtype=np.float32)
+        # Rebuild the host buffers (incumbent tracking resumes) and the
+        # device-resident twin with ONE bulk upload; incremental appends
+        # resume from here.
+        self._host = HostHistory.from_host(x, y)
+        self._hist = DeviceHistory.from_host(x, y)
         self._gp_state = None  # refit (cold) on the next suggest
         tr = state.get("tr")
         if tr is not None:
@@ -580,6 +640,168 @@ def _make_tr_candidates(
     return jnp.concatenate([global_c, box, cov_c, dir_c, cem_c], axis=0)
 
 
+def maybe_prewarm_fused_step(algo, batch=0):
+    """Observe-side prewarm trigger shared by the GP algorithms (`tpu_bo`,
+    `asha_bo` — any algorithm exposing the `_host`/`_hist`/`_step_kw`
+    surface): when the history nears the next pow-2 bucket, background-
+    compile that bucket's fused step so the crossing costs a jit-cache hit
+    instead of a synchronous multi-second stall.  O(1) planning per
+    observe; needs one prior suggest to know the q bucket.
+
+    In the local-TR regime (``count > tr_local_m``) the fused step's fit
+    shape is pinned, but the on-device subset gather still re-buckets with
+    the history — its (much smaller) compile is prewarmed instead; the
+    approach INTO the regime warms the gather's first shape the same
+    way."""
+    if not algo.prewarm or algo._last_q_bucket is None:
+        return
+    count = algo._host.count
+    if count < algo.n_init:
+        return
+
+    def warm_gather(m_hist):
+        width = algo._hist.n_cols
+        dist_cols = width - algo._step_kw().get("fixed_tail_cols", 0)
+        floor = algo._hist.floor
+        m = algo.tr_local_m
+        algo._prewarmer.maybe_start(
+            ("local_subset", m_hist, width, m, dist_cols),
+            lambda: prewarm_local_subset(
+                m_hist, width, m, dist_cols, floor=floor
+            ),
+        )
+
+    if algo.trust_region and count > algo.tr_local_m:
+        target_m = plan_next_bucket(
+            count, floor=algo._hist.floor, fill=algo.prewarm_fill,
+            batch=batch,
+        )
+        if target_m is not None:
+            warm_gather(target_m)
+        return
+    if algo.trust_region and (
+        count >= algo.prewarm_fill * algo.tr_local_m
+        or count + batch > algo.tr_local_m
+    ):
+        # Approaching the full->local switch (by fill, or because one more
+        # batch of this size lands past it): the first local_view call
+        # feeds the gather an x of shape next_pow2 of that landing count —
+        # warm that first signature too.
+        warm_gather(
+            _next_pow2(
+                max(algo.tr_local_m + 1, count + batch),
+                floor=algo._hist.floor,
+            )
+        )
+    target_m = plan_fused_step_bucket(
+        count,
+        floor=algo._hist.floor,
+        fill=algo.prewarm_fill,
+        batch=batch,
+        trust_region=algo.trust_region,
+        tr_local_m=algo.tr_local_m,
+    )
+    if target_m is not None:
+        start_bucket_prewarm(
+            algo._prewarmer,
+            target_m,
+            algo._hist.n_cols,
+            algo._last_q_bucket,
+            algo._step_kw(),
+            warm_refit=algo._gp_state is not None,
+        )
+
+
+def start_bucket_prewarm(prewarmer, target_m, width, q_bucket, step_kw, *,
+                         warm_refit=False, fixed_tail_cols=0):
+    """Hand the prewarmer a compile closure replaying the fused step's
+    EXACT static-arg signature at the ``(target_m, width)`` bucket.  The
+    dedup key is built from the same statics, so each signature compiles
+    at most once per prewarmer.  ``warm_refit``: steady-state boundary
+    calls run ``refit_steps`` when configured (the refit path is warm), so
+    the prewarm signature must bake that in or it warms the wrong cache
+    entry.  Shared by ``tpu_bo`` and ``asha_bo``."""
+    kw = dict(step_kw)
+    kw.pop("tr_length", None)
+    fixed_tail_cols = kw.pop("fixed_tail_cols", fixed_tail_cols)
+    refit_steps = kw.pop("refit_steps", None)
+    if warm_refit and refit_steps is not None:
+        kw["fit_steps"] = refit_steps
+    key = (
+        target_m,
+        width,
+        q_bucket,
+        fixed_tail_cols,
+        tuple(sorted((k, str(v)) for k, v in kw.items())),
+    )
+    return prewarmer.maybe_start(
+        key,
+        lambda: prewarm_suggest_step(
+            target_m, width, q_bucket, fixed_tail_cols=fixed_tail_cols, **kw
+        ),
+    )
+
+
+def prewarm_suggest_step(
+    m,
+    width,
+    q_bucket,
+    *,
+    n_candidates,
+    kernel,
+    acq,
+    fit_steps,
+    local_frac,
+    local_sigma,
+    beta,
+    trust_region=False,
+    tr_perturb_dims=20,
+    y_transform="none",
+    fixed_tail_cols=0,
+    mesh=None,
+):
+    """Compile the fused suggest step for the ``(m, width)`` buffer bucket
+    by CALLING the jitted function on zero dummies — the call populates the
+    jit cache (AOT ``lower().compile()`` would not), so the first real call
+    at this bucket is a cache hit.  Runs on the prewarmer's background
+    thread; XLA compilation releases the GIL, so the main thread keeps
+    producing rounds.  Deliberately bypasses ``run_suggest_step_arrays``:
+    a prewarm compile must never book a ``jax.retraces`` sample (that
+    counter reports the synchronous stalls a suggest actually paid)."""
+    zeros = jnp.zeros((m, width), jnp.float32)
+    rows, _ = _suggest_step(
+        jax.random.PRNGKey(0),
+        zeros,
+        zeros[:, 0],
+        zeros[:, 0],
+        # best_x carries only the FREE columns: multi-fidelity callers pass
+        # the incumbent without the context tail, and jit caches on shape —
+        # a (width,) dummy would warm an entry the real call never hits.
+        jnp.zeros((width - fixed_tail_cols,), jnp.float32),
+        init_hypers(width),
+        jnp.asarray(1.0, jnp.float32),
+        q=q_bucket,
+        n_candidates=n_candidates,
+        kernel=kernel,
+        acq=acq,
+        fit_steps=fit_steps,
+        local_frac=local_frac,
+        local_sigma=local_sigma,
+        beta=beta,
+        trust_region=trust_region,
+        tr_perturb_dims=tr_perturb_dims,
+        y_transform=y_transform,
+        fixed_tail_cols=fixed_tail_cols,
+        mesh=mesh,
+    )
+    # No block_until_ready: the first call compiles SYNCHRONOUSLY (the
+    # cache insert happens before it returns); only the dummy's execution
+    # is async, and waiting on it would hold the prewarmer's completed
+    # bookkeeping tens of ms past the insert — exactly the window in which
+    # the retrace detector would misread the growth.
+    del rows
+
+
 def run_suggest_step(
     key,
     x_obs,
@@ -599,15 +821,21 @@ def run_suggest_step(
     trust_region=False,
     tr_length=None,
     tr_perturb_dims=20,
+    y_transform="none",
     fixed_tail_cols=0,
     mesh=None,
 ):
     """Host wrapper around the fused jit: pow-2 pad the observation buffers
-    on host, upload, and delegate to :func:`run_suggest_step_arrays`.  Used
-    by the local-subset (trust-region) path, whose fit set is a fresh
-    host-side gather each round; the full-history path goes through the
-    algorithm's device-resident :class:`DeviceHistory` instead and never
-    re-uploads rows the device already holds.
+    on host, upload, and delegate to :func:`run_suggest_step_arrays`.
+
+    No longer on the algorithms' hot path — both the full-history and the
+    local-subset (trust-region) fit sets now come straight off the
+    device-resident :class:`DeviceHistory` buffers (``fit_view`` /
+    ``local_view``), so nothing history-sized is re-padded or re-uploaded
+    per round.  This entry remains for host-array callers and as the
+    re-upload REFERENCE the bit-equality regression tests compare the
+    resident path against (``tests/unit/test_device_history.py``,
+    ``tests/unit/test_host_history.py``).
     """
     n, width = np.asarray(x_obs).shape
     n_pad = _next_pow2(n)
@@ -636,6 +864,7 @@ def run_suggest_step(
         trust_region=trust_region,
         tr_length=tr_length,
         tr_perturb_dims=tr_perturb_dims,
+        y_transform=y_transform,
         fixed_tail_cols=fixed_tail_cols,
         mesh=mesh,
     )
@@ -661,8 +890,10 @@ def run_suggest_step_arrays(
     trust_region=False,
     tr_length=None,
     tr_perturb_dims=20,
+    y_transform="none",
     fixed_tail_cols=0,
     mesh=None,
+    prewarmer=None,
 ):
     """Device-array entry to the fused jit: ``(x, y, mask)`` are already
     pow-2-padded device (or device-ready) buffers — typically
@@ -689,6 +920,19 @@ def run_suggest_step_arrays(
             tel_before = cache_size() if cache_size is not None else -1
         except Exception:  # private jax API — degrade, never raise into suggest
             cache_size, tel_before = None, -1
+        # Background prewarm compiles insert cache entries too: sample the
+        # completed-prewarm count around the dispatch so a prewarm landing
+        # mid-window is not booked as a synchronous retrace (jax.retraces
+        # must report only the stalls THIS call paid).  Scoped to the
+        # caller's own prewarmer when given — only ITS compiles share
+        # these jit signatures; the process-global fallback would let an
+        # unrelated instance's warm mask a genuine retrace here.
+        tel_completed = (
+            prewarmer.completed_count
+            if prewarmer is not None
+            else completed_prewarm_count
+        )
+        tel_prewarms_before = tel_completed()
         tel_t0 = time.perf_counter()
     rows, state = _suggest_step(
         key,
@@ -710,12 +954,27 @@ def run_suggest_step_arrays(
         beta=beta,
         trust_region=trust_region,
         tr_perturb_dims=tr_perturb_dims,
+        y_transform=y_transform,
         fixed_tail_cols=fixed_tail_cols,
         mesh=mesh,
     )
     if tel_t0 is not None:
         try:
-            retraced = cache_size is not None and cache_size() > tel_before
+            retraced = (
+                cache_size is not None
+                and cache_size() > tel_before
+                # A prewarm that completed during this window explains the
+                # growth; classify as a cached dispatch (conservative: a
+                # genuine retrace coinciding with a completing prewarm
+                # goes uncounted rather than a cache hit being booked as a
+                # stall).  Prewarm compiles are synchronous inside the
+                # jitted call and bookkeeping follows within microseconds
+                # (no block_until_ready on the dummy), so the completed
+                # delta is a tight proxy for "an insert landed here" — a
+                # blanket in-flight check would instead blind the counter
+                # to genuine retraces for the whole life of a compile.
+                and tel_completed() == tel_prewarms_before
+            )
         except Exception:  # private jax API — degrade, never raise into suggest
             retraced = False
         TELEMETRY.record_span(
@@ -771,6 +1030,7 @@ def _dedup_fill_device(idx, ei_rank, q):
         "beta",
         "trust_region",
         "tr_perturb_dims",
+        "y_transform",
         "fixed_tail_cols",
         "mesh",
     ),
@@ -794,10 +1054,18 @@ def _suggest_step(
     beta,
     trust_region=False,
     tr_perturb_dims=20,
+    y_transform="none",
     fixed_tail_cols=0,
     mesh=None,
 ):
     """The whole GP-BO suggest round as ONE compiled computation.
+
+    ``y_transform="copula"`` rank-Gaussianizes the masked targets in-jit
+    (``fit_gp`` applies ``masked_copula_transform``); ``y`` arrives RAW, so
+    the device-resident buffers feed this step directly with no per-round
+    host transform or y re-upload.  The transform is monotone, so every
+    rank-based consumer below (elite covariance, EI incumbent) is
+    unaffected by reading raw ``y``.
 
     ``fixed_tail_cols``: the last k input columns are context, not free
     variables — candidates are generated over the leading columns only and
@@ -805,7 +1073,10 @@ def _suggest_step(
     fidelity column to max budget so selection optimizes the predicted
     FULL-budget value).  Returned rows include only the free columns.
     """
-    state = fit_gp(x, y, mask, kind=kernel, n_steps=fit_steps, init=warm_hypers)
+    state = fit_gp(
+        x, y, mask, kind=kernel, n_steps=fit_steps, init=warm_hypers,
+        y_transform=y_transform,
+    )
     k_cand, k_acq = jax.random.split(key)
     d_free = x.shape[1] - fixed_tail_cols
     if trust_region:

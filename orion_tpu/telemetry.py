@@ -155,6 +155,14 @@ class Telemetry:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + int(n)
 
+    def counter_value(self, name, default=0):
+        """Current value of counter ``name`` (``default`` when never
+        incremented).  Reader for in-process assertions — the
+        boundary-crossing tests and ``bench.py --smoke`` check
+        ``jax.retraces``/``jax.prewarms`` deltas through this."""
+        with self._lock:
+            return self._counters.get(name, default)
+
     def set_gauge(self, name, value):
         """Set gauge ``name`` to ``value`` (last write wins)."""
         if not self.enabled:

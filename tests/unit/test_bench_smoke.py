@@ -70,9 +70,27 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
     assert payload["smoke"] is True
+    # The emitted line itself must carry the breakdown + storage keys —
+    # r05's recorded line lacked them, and only an assertion on the payload
+    # (not just on values we happen to index) pins the schema.
+    assert "breakdown_ms" in payload and "storage_ms" in payload
     breakdown = payload["breakdown_ms"]
     for key in BREAKDOWN_KEYS:
         assert key in breakdown, f"breakdown_ms lost its {key!r} stage"
+    # Steady-state host tax, trackable across BENCH_* separately from
+    # throughput: the sum of the host stages (everything except
+    # wait_transfer and the separately-tracked storage_ms).
+    assert payload["host_ms_per_round"] == round(
+        sum(v for k, v in breakdown.items()
+            if k not in ("wait_transfer", "storage_ms")),
+        3,
+    )
+    # The pow-2 boundary-crossing contract: a prewarmed crossing costs a
+    # jit-cache hit, not a synchronous retrace (None = jax introspection
+    # unavailable — skipped, not failed; bench.py itself hard-asserts 0).
+    assert payload["prewarm"]["retraces_after_warm"] in (None, 0)
+    if payload["prewarm"]["retraces_after_warm"] == 0:
+        assert payload["prewarm"]["prewarms"] >= 1
     for backend in ("sqlite", "network"):
         assert payload["storage_ms"][backend] > 0
         # The batched write path commits a whole q-round as ONE transaction

@@ -1,0 +1,95 @@
+"""Device-copula parity: the in-jit rank->normal-quantile transform
+(`sampling.masked_copula_transform`, what the fused suggest step now runs
+over the resident buffers) must match the host reference
+(`tpu_bo.copula_transform`, scipy `ndtri`) within float32 tolerance —
+including duplicate objective values, where both sides must agree on
+first-occurrence tie ranks (stable sorts) — and must preserve the argmin
+through the transform (it is the monotonicity the acquisition relies on).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from orion_tpu.algo.gp.gp import fit_gp
+from orion_tpu.algo.history import _next_pow2
+from orion_tpu.algo.sampling import masked_copula_transform
+from orion_tpu.algo.tpu_bo import copula_transform
+
+# f32 ndtri vs f64 ndtri-cast-to-f32: a few ulps at the extreme quantiles.
+ATOL = 5e-5
+
+
+def _padded(y):
+    n = y.shape[0]
+    m = _next_pow2(n, floor=8)
+    y_pad = np.zeros((m,), dtype=np.float32)
+    y_pad[:n] = y
+    mask = np.zeros((m,), dtype=np.float32)
+    mask[:n] = 1.0
+    return y_pad, mask, n
+
+
+@pytest.mark.parametrize("n", [3, 17, 64, 200])
+def test_device_matches_host_on_random_y(n):
+    rng = np.random.default_rng(n)
+    y = rng.normal(scale=100.0, size=n).astype(np.float32)
+    y_pad, mask, _ = _padded(y)
+    dev = np.asarray(masked_copula_transform(jnp.asarray(y_pad), jnp.asarray(mask)))
+    host = copula_transform(y)
+    np.testing.assert_allclose(dev[:n], host, atol=ATOL, rtol=1e-5)
+    # Padded rows come back exactly 0.0 — the all-zeros-past-count buffer
+    # invariant the device history relies on.
+    assert np.all(dev[n:] == 0.0)
+
+
+def test_device_matches_host_with_duplicates():
+    rng = np.random.default_rng(7)
+    base = rng.normal(size=10).astype(np.float32)
+    # Heavy duplication, including a duplicated minimum.
+    y = np.concatenate([base, base[:5], np.full(6, base.min(), np.float32)])
+    rng.shuffle(y)
+    y_pad, mask, n = _padded(y)
+    dev = np.asarray(masked_copula_transform(jnp.asarray(y_pad), jnp.asarray(mask)))
+    host = copula_transform(y)
+    # Duplicates get DISTINCT consecutive ranks; both sides must assign
+    # them in first-occurrence order (stable sorts) for per-position parity.
+    np.testing.assert_allclose(dev[:n], host, atol=ATOL, rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_argmin_preserved_through_transform(seed):
+    rng = np.random.default_rng(seed)
+    y = rng.normal(size=50).astype(np.float32)
+    y[rng.integers(50)] = y.min() - 1.0  # unambiguous minimum
+    y_pad, mask, n = _padded(y)
+    dev = np.asarray(masked_copula_transform(jnp.asarray(y_pad), jnp.asarray(mask)))
+    assert int(np.argmin(dev[:n])) == int(np.argmin(y))
+    # Full monotonicity: the transform preserves the entire order.
+    assert np.array_equal(np.argsort(dev[:n], kind="stable"),
+                          np.argsort(y, kind="stable"))
+
+
+def test_fit_gp_applies_transform_in_jit():
+    """fit_gp(y_transform='copula') must fit exactly what a host
+    pre-transform would have fed it: the stored GPState.y is the
+    transformed target and the posterior factorization matches the
+    explicitly-pre-transformed fit to float32 tolerance."""
+    rng = np.random.default_rng(3)
+    n, d = 24, 4
+    m = _next_pow2(n, floor=8)
+    x = np.zeros((m, d), dtype=np.float32)
+    x[:n] = rng.uniform(size=(n, d))
+    y_pad, mask, _ = _padded(rng.normal(scale=10.0, size=n).astype(np.float32))
+    in_jit = fit_gp(jnp.asarray(x), jnp.asarray(y_pad), jnp.asarray(mask),
+                    n_steps=5, y_transform="copula")
+    pre = fit_gp(
+        jnp.asarray(x),
+        masked_copula_transform(jnp.asarray(y_pad), jnp.asarray(mask)),
+        jnp.asarray(mask),
+        n_steps=5,
+    )
+    np.testing.assert_allclose(np.asarray(in_jit.y), np.asarray(pre.y),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(in_jit.alpha), np.asarray(pre.alpha),
+                               atol=1e-5, rtol=1e-4)

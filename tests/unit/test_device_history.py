@@ -23,7 +23,7 @@ import pytest
 
 from orion_tpu.algo.base import create_algo
 from orion_tpu.algo.history import DeviceHistory, _next_pow2
-from orion_tpu.algo.tpu_bo import copula_transform, run_suggest_step
+from orion_tpu.algo.tpu_bo import run_suggest_step
 from orion_tpu.core.experiment import build_experiment
 from orion_tpu.core.producer import Producer
 from orion_tpu.core.trial import Result
@@ -48,25 +48,23 @@ def _obs(algo, X, scale=1.0):
 
 def _reupload_rows(algo, num, key):
     """The full host re-pad/re-upload reference path, replicating exactly
-    what `_suggest_cube`'s device-resident branch feeds the fused jit."""
+    what `_suggest_cube`'s device-resident branch feeds the fused jit.
+    y goes in RAW: the copula transform runs in-jit (fit_gp's y_transform)
+    on both paths, so transport bit-equality still covers it."""
     n = algo._x.shape[0]
     center = (
         algo._tr_center
         if algo._tr_center is not None and algo._tr_center < n
         else int(np.argmin(algo._y))
     )
-    y_fit = (
-        copula_transform(algo._y)
-        if algo.y_transform == "copula"
-        else algo._y
-    )
     rows, _ = run_suggest_step(
         key,
         algo._x,
-        y_fit,
+        algo._y,
         algo._x[center],
         algo._gp_state,
         num,
+        y_transform=algo.y_transform,
         n_candidates=algo.n_candidates,
         kernel=algo.kernel,
         acq=algo.acq,

@@ -1,0 +1,141 @@
+"""`observe_arrays` differential test: the buffered-growth host history
+(amortized-doubling buffers, incremental incumbent) must be bit-identical
+to the old ``np.concatenate`` mirror path — for the stored history, the
+incumbent, the trust-region state, and the suggested rows — over a
+multi-round script, including growth across the buffer-doubling boundary
+and a lie-fantasizing deepcopy round (copy-on-write discipline).
+"""
+
+import copy
+
+import jax
+import numpy as np
+
+from orion_tpu.algo.base import create_algo
+from orion_tpu.algo.history import HostHistory
+from orion_tpu.algo.tpu_bo import run_suggest_step, tr_update_batch
+from orion_tpu.space.dsl import build_space
+
+D = 3
+_CFG = {"n_init": 8, "n_candidates": 128, "fit_steps": 3}
+
+
+def _space():
+    return build_space({f"x{i}": "uniform(0, 1)" for i in range(D)})
+
+
+def _obs(algo, X, ys):
+    params = [{f"x{i}": float(r[i]) for i in range(D)} for r in np.asarray(X)]
+    algo.observe(params, [{"objective": float(v)} for v in ys])
+
+
+def test_buffered_observe_matches_concatenate_reference():
+    """Multi-round script (uneven batches, crosses the floor-64 doubling
+    boundary): after every round the algorithm's state must equal mirrors
+    maintained the old way — np.concatenate + full argmin + tr_update_batch
+    — and the suggestion produced from that state must be bit-identical to
+    the fused step fed the reference arrays."""
+    algo = create_algo(_space(), {"tpu_bo": dict(_CFG)}, seed=21)
+    rng = np.random.default_rng(9)
+
+    ref_x = np.zeros((0, D), dtype=np.float32)
+    ref_y = np.zeros((0,), dtype=np.float32)
+    ref_tr = (algo.tr_length_init, 0, 0)
+
+    for batch in (8, 8, 5, 16, 3, 31, 8):  # ends at n=79, past the 64 cap
+        X = rng.uniform(size=(batch, D)).astype(np.float32)
+        # Occasional duplicate objectives exercise first-occurrence argmin.
+        ys = np.round(np.sum(X**2, axis=1).astype(np.float32), 2)
+        prev_n = ref_x.shape[0]
+        prev_best = float(np.min(ref_y)) if prev_n else np.inf
+        ref_x = np.concatenate([ref_x, X])
+        ref_y = np.concatenate([ref_y, ys])
+        if algo.trust_region and prev_n >= algo.n_init:
+            ref_tr = tr_update_batch(
+                ref_tr[0], ref_tr[1], ref_tr[2], prev_best, ys,
+                chunk=algo.tr_update_every, succ_tol=algo.tr_succ_tol,
+                fail_tol=algo.tr_fail_tol, length_init=algo.tr_length_init,
+                length_min=algo.tr_length_min, length_max=algo.tr_length_max,
+                improve_tol=algo.tr_improve_tol,
+            )[:3]
+        _obs(algo, X, ys)
+
+        # History: bit-identical views.
+        assert np.array_equal(algo._x, ref_x)
+        assert np.array_equal(algo._y, ref_y)
+        # Incumbent: the tracked argmin IS np.argmin (first occurrence).
+        assert algo._host.best_idx == int(np.argmin(ref_y))
+        assert algo._host.best_y == float(np.min(ref_y))
+        # Trust-region state.
+        assert (algo._tr_length, algo._tr_succ, algo._tr_fail) == ref_tr
+
+    # Suggested rows: the state the buffered path accumulated must produce
+    # the exact suggestion the reference arrays produce.
+    expected_key = jax.random.split(algo.rng_key)[1]
+    ref_rows, _ = run_suggest_step(
+        expected_key,
+        ref_x,
+        ref_y,
+        ref_x[int(np.argmin(ref_y))],
+        algo._gp_state,
+        16,
+        n_candidates=algo.n_candidates,
+        kernel=algo.kernel,
+        acq=algo.acq,
+        fit_steps=algo.fit_steps,
+        refit_steps=algo.refit_steps,
+        local_frac=algo.local_frac,
+        local_sigma=algo.local_sigma,
+        beta=algo.beta,
+        trust_region=algo.trust_region,
+        tr_length=algo._tr_length,
+        tr_perturb_dims=algo.tr_perturb_dims,
+        y_transform=algo.y_transform,
+        mesh=None,
+    )
+    out = np.asarray(algo._suggest_cube(16))
+    assert np.array_equal(out, np.asarray(ref_rows))
+
+
+def test_deepcopy_clone_copy_on_write():
+    """The producer's naive copy: clone appends (lies) must not leak into
+    the real history, and the real side's later appends must not clobber
+    the clone — on the HOST buffers, same discipline as DeviceHistory."""
+    algo = create_algo(_space(), {"tpu_bo": dict(_CFG)}, seed=4)
+    rng = np.random.default_rng(2)
+    X = rng.uniform(size=(12, D)).astype(np.float32)
+    ys = np.sum(X**2, axis=1)
+    _obs(algo, X, ys)
+    snapshot = algo._y.copy()
+
+    clone = copy.deepcopy(algo)
+    assert clone._host._x is algo._host._x  # shared until a write
+    Xl = rng.uniform(size=(4, D)).astype(np.float32)
+    _obs(clone, Xl, np.full(4, -1.0))  # lies better than everything
+    assert clone._host.count == 16 and algo._host.count == 12
+    assert np.array_equal(algo._y, snapshot)  # original untouched
+    assert clone._host.best_y == -1.0
+    assert algo._host.best_y == float(np.min(snapshot))
+
+    # Original appends independently afterwards; clone's rows survive.
+    Xr = rng.uniform(size=(3, D)).astype(np.float32)
+    _obs(algo, Xr, np.sum(Xr**2, axis=1))
+    assert algo._host.count == 15
+    assert clone._host.count == 16 and np.all(clone._y[12:] == -1.0)
+
+
+def test_host_history_growth_and_ties():
+    hist = HostHistory(2, floor=4)
+    hist.append(np.ones((3, 2)), np.asarray([5.0, 2.0, 2.0]))
+    assert hist.count == 3 and hist.best_idx == 1 and hist.best_y == 2.0
+    # Tie with the current best: earliest index wins (np.argmin semantics).
+    hist.append(2 * np.ones((4, 2)), np.asarray([2.0, 3.0, 4.0, 5.0]))
+    assert hist.count == 7 and hist.best_idx == 1
+    # Strictly better in a later batch moves the incumbent.
+    hist.append(3 * np.ones((2, 2)), np.asarray([1.5, 9.0]))
+    assert hist.best_idx == 7 and hist.best_y == 1.5
+    assert hist.x.shape == (9, 2) and hist.y.shape == (9,)
+    assert np.all(hist.x[7:] == 3.0)
+    # Empty append is a no-op.
+    hist.append(np.zeros((0, 2)), np.zeros((0,)))
+    assert hist.count == 9

@@ -1,0 +1,312 @@
+"""Pow-2 boundary-crossing prewarm: with prewarm enabled, growing the
+history across a bucket boundary must cost ZERO synchronous retraces in the
+post-warm rounds (the background compile made the crossing a jit-cache
+hit), measured through the `jax.retraces` telemetry counter — and with
+prewarm disabled the same crossing must count exactly ONE retrace, so the
+counter channel itself stays honest.
+"""
+
+import numpy as np
+import pytest
+
+from orion_tpu import telemetry as tel
+from orion_tpu.algo.base import create_algo
+from orion_tpu.algo.prewarm import (
+    BucketPrewarmer,
+    plan_fused_step_bucket,
+    plan_next_bucket,
+)
+from orion_tpu.space.dsl import build_space
+
+D = 3
+
+
+def _retrace_introspection_available():
+    from orion_tpu.algo.tpu_bo import _suggest_step
+
+    return hasattr(_suggest_step, "_cache_size")
+
+
+def _make(seed, n_candidates, **kw):
+    # Distinct n_candidates per test: the jit cache is process-wide, and a
+    # signature another test already compiled would fake a cache hit.
+    space = build_space({f"x{i}": "uniform(0, 1)" for i in range(D)})
+    cfg = dict(n_init=4, n_candidates=n_candidates, fit_steps=2, **kw)
+    return create_algo(space, {"tpu_bo": cfg}, seed=seed)
+
+
+def _obs(algo, rng, batch):
+    X = rng.uniform(size=(batch, D)).astype(np.float32)
+    params = [{f"x{i}": float(r[i]) for i in range(D)} for r in X]
+    algo.observe(params, [{"objective": float(np.sum(r**2))} for r in X])
+
+
+@pytest.fixture
+def telemetry():
+    enabled_before = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    yield tel.TELEMETRY
+    if not enabled_before:
+        tel.TELEMETRY.disable()
+
+
+@pytest.mark.skipif(
+    not _retrace_introspection_available(),
+    reason="jax private _cache_size accessor unavailable",
+)
+def test_prewarm_zero_retraces_across_pow2_boundary(telemetry):
+    algo = _make(seed=31, n_candidates=96)
+    rng = np.random.default_rng(31)
+    _obs(algo, rng, 40)  # bucket 64, under the 0.75 fill threshold
+    algo.suggest(8)  # compiles the 64-bucket AND records the q bucket
+    _obs(algo, rng, 16)  # count 56 >= 48: prewarm of bucket 128 launches
+    algo._prewarmer.wait()
+    assert not algo._prewarmer.in_flight
+    assert telemetry.counter_value("jax.prewarms") >= 1
+
+    base = telemetry.counter_value("jax.retraces")
+    _obs(algo, rng, 16)  # count 72: crosses 64 -> 128
+    algo.suggest(8)  # post-warm round: must be a jit-cache hit
+    algo.suggest(8)
+    assert telemetry.counter_value("jax.retraces") == base, (
+        "pow-2 boundary crossing paid a synchronous retrace despite prewarm"
+    )
+    algo._prewarmer.wait()  # leave no in-flight warms for later tests
+
+
+@pytest.mark.skipif(
+    not _retrace_introspection_available(),
+    reason="jax private _cache_size accessor unavailable",
+)
+def test_prewarm_zero_retraces_with_batches_larger_than_fill_window(telemetry):
+    """Observe batches of bucket/2: the fill window is stepped over, so
+    only the batch-anticipation trigger can save the crossing."""
+    algo = _make(seed=35, n_candidates=104)
+    rng = np.random.default_rng(35)
+    _obs(algo, rng, 32)
+    algo.suggest(8)
+    _obs(algo, rng, 32)  # count 64: one more batch lands in 128 -> warm it
+    algo._prewarmer.wait()
+    base = telemetry.counter_value("jax.retraces")
+    _obs(algo, rng, 32)  # count 96: crosses 64 -> 128 in one step
+    # Drain the fill-triggered warm of bucket 256 (count 96 >= 0.75*128)
+    # BEFORE the measured suggest: a prewarm completing inside its window
+    # would discount any growth and make the assertion below vacuous.
+    algo._prewarmer.wait()
+    algo.suggest(8)
+    assert telemetry.counter_value("jax.retraces") == base
+
+
+@pytest.mark.skipif(
+    not _retrace_introspection_available(),
+    reason="jax private _cache_size accessor unavailable",
+)
+def test_disabled_prewarm_counts_exactly_one_retrace(telemetry):
+    """The honesty half: same crossing, prewarm off -> the boundary compile
+    happens synchronously inside suggest and the counter reports exactly
+    one retrace (not zero — the channel must not be blind — and not more)."""
+    algo = _make(seed=32, n_candidates=112, prewarm=False)
+    rng = np.random.default_rng(32)
+    _obs(algo, rng, 56)
+    algo.suggest(8)  # compiles the 64-bucket
+    base = telemetry.counter_value("jax.retraces")
+    _obs(algo, rng, 16)  # crosses 64 -> 128; nothing was prewarmed
+    algo.suggest(8)  # pays the synchronous boundary compile
+    algo.suggest(8)  # same bucket: cache hit
+    assert telemetry.counter_value("jax.retraces") == base + 1
+    assert not algo._prewarmer._threads  # nothing launched
+
+
+def test_plan_next_bucket_thresholds():
+    assert plan_next_bucket(0, floor=64) is None
+    assert plan_next_bucket(40, floor=64) is None  # 40 < 0.75 * 64
+    assert plan_next_bucket(48, floor=64) == 128
+    assert plan_next_bucket(64, floor=64) == 128
+    assert plan_next_bucket(65, floor=64) is None  # 65 < 0.75 * 128
+    assert plan_next_bucket(96, floor=64) == 256
+    assert plan_next_bucket(20, floor=64, fill=0.25) == 128
+
+
+def test_plan_next_bucket_anticipates_large_batches():
+    """A batch bigger than the fill-window slack must not skip the trigger:
+    if one more same-sized observe crosses the bucket, warm the bucket it
+    LANDS in — possibly several ahead (the q=1024 regime)."""
+    # q=1024 at bucket 2048: the fill window [1536, 2048) may be stepped
+    # over entirely, and the landing bucket is 4096, not 2 * 2048 later.
+    assert plan_next_bucket(1500, floor=64, batch=1024) == 4096
+    # q=64 at bucket 128: count 90 -> 154 skips the [96, 128) window and
+    # lands in bucket 256 (the 128 bucket is never fitted).
+    assert plan_next_bucket(90, floor=64, batch=64) == 256
+    # Small batch that cannot cross: fill heuristic governs, unchanged.
+    assert plan_next_bucket(90, floor=64, batch=8) is None
+    assert plan_next_bucket(100, floor=64, batch=8) == 256
+    # Batch-crossing check fires even below the fill threshold.
+    assert plan_next_bucket(60, floor=64, batch=16) == 128
+
+
+def test_plan_fused_step_bucket_local_subset_pinning():
+    # Past tr_local_m the FUSED STEP's fit shape is pinned: nothing to warm
+    # (the small gather jit is warmed separately by the trigger).
+    assert (
+        plan_fused_step_bucket(
+            300, floor=64, trust_region=True, tr_local_m=256
+        )
+        is None
+    )
+    # A crossing that lands past tr_local_m would target the subset pad —
+    # but at count 250 the fit already runs at 256, so warming 256 again
+    # would be a no-op that still books a jax.prewarms count: None.
+    assert (
+        plan_fused_step_bucket(
+            250, floor=64, trust_region=True, tr_local_m=256
+        )
+        is None
+    )
+    # Same shape of crossing where the subset pad is NOT yet compiled
+    # (tr_local_m=300 pads to 512 while the current fit shape is 256).
+    assert (
+        plan_fused_step_bucket(
+            250, floor=64, trust_region=True, tr_local_m=300
+        )
+        == 512
+    )
+    # Ordinary crossing below the subset switch: the raw next bucket.
+    assert (
+        plan_fused_step_bucket(
+            48, floor=64, trust_region=True, tr_local_m=256
+        )
+        == 128
+    )
+    assert plan_fused_step_bucket(48, floor=64, trust_region=False) == 128
+
+
+def test_local_tr_regime_prewarms_subset_gather():
+    """Past tr_local_m the trigger must warm the LOCAL-SUBSET gather for
+    the next history bucket (its shape still re-buckets with the history)
+    instead of the pinned fused step — and never launch a no-op fused-step
+    warm."""
+    from orion_tpu.algo.tpu_bo import maybe_prewarm_fused_step
+
+    algo = _make(seed=33, n_candidates=72, trust_region=True, tr_local_m=20)
+    rng = np.random.default_rng(33)
+    _obs(algo, rng, 30)  # past tr_local_m=20; fit bucket 64
+    algo.suggest(4)      # records the q bucket, compiles the subset path
+    _obs(algo, rng, 20)  # count 50 >= 0.75 * 64: trigger fires
+    algo._prewarmer.wait()
+    keys = list(algo._prewarmer._threads)
+    assert any(k[0] == "local_subset" and k[1] == 128 for k in keys), keys
+    # No fused-step warm was launched (its fit shape is pinned here).
+    assert all(k[0] == "local_subset" for k in keys), keys
+    # Direct trigger call is idempotent (dedup by signature key).
+    n_before = len(algo._prewarmer._threads)
+    maybe_prewarm_fused_step(algo)
+    algo._prewarmer.wait()
+    assert len(algo._prewarmer._threads) == n_before
+
+
+def test_approach_into_local_regime_prewarms_first_gather_shape():
+    """While still UNDER tr_local_m, nearing the full->local switch must
+    warm the gather's FIRST signature (x of shape next_pow2(tr_local_m+1))
+    — otherwise the first local_view call pays a synchronous compile."""
+    algo = _make(seed=34, n_candidates=88, trust_region=True, tr_local_m=40)
+    rng = np.random.default_rng(34)
+    _obs(algo, rng, 20)  # under the 0.75 * 40 = 30 approach threshold
+    algo.suggest(4)
+    assert not algo._prewarmer._threads
+    _obs(algo, rng, 11)  # count 31 >= 30, still <= tr_local_m
+    algo._prewarmer.wait()
+    keys = list(algo._prewarmer._threads)
+    assert ("local_subset", 64, D, 40, D) in keys, keys
+
+
+def test_completed_prewarm_count_moves_on_success_and_failure():
+    from orion_tpu.algo.prewarm import completed_prewarm_count
+
+    warmer = BucketPrewarmer()
+    base = completed_prewarm_count()
+    warmer.maybe_start("ok", lambda: None)
+    warmer.wait()
+    assert completed_prewarm_count() == base + 1
+
+    def boom():
+        raise RuntimeError("x")
+
+    warmer.maybe_start("fail", boom)
+    warmer.wait()
+    # Failures count too: the attempt may still have inserted cache
+    # entries, which is what the retrace detector needs to know about.
+    assert completed_prewarm_count() == base + 2
+    # Per-instance twin (the retrace detector's scoped source).
+    assert warmer.completed_count() == 2
+    assert not warmer.in_flight
+
+
+@pytest.mark.skipif(
+    not _retrace_introspection_available(),
+    reason="jax private _cache_size accessor unavailable",
+)
+def test_prewarm_signature_matches_fixed_tail_callers():
+    """asha_bo passes best_x WITHOUT the fidelity context column
+    (shape (width - fixed_tail_cols,)); the prewarm dummy must match that
+    shape or the warmed cache entry is never hit and the boundary still
+    retraces (regression: the dummy was (width,))."""
+    import jax
+    import jax.numpy as jnp
+
+    from orion_tpu.algo.tpu_bo import (
+        _suggest_step,
+        prewarm_suggest_step,
+        run_suggest_step_arrays,
+    )
+
+    kw = dict(
+        n_candidates=80,  # unique statics: process-wide jit cache
+        kernel="matern52",
+        acq="thompson",
+        fit_steps=2,
+        local_frac=0.5,
+        local_sigma=0.1,
+        beta=2.0,
+        trust_region=False,
+        tr_perturb_dims=20,
+        y_transform="none",
+        mesh=None,
+    )
+    m, width, q = 16, 4, 8
+    prewarm_suggest_step(m, width, q, fixed_tail_cols=1, **kw)
+    before = _suggest_step._cache_size()
+    mask = np.zeros((m,), dtype=np.float32)
+    mask[:3] = 1.0
+    rows, _ = run_suggest_step_arrays(
+        jax.random.PRNGKey(1),
+        jnp.zeros((m, width), jnp.float32),
+        jnp.zeros((m,), jnp.float32),
+        jnp.asarray(mask),
+        np.zeros((width - 1,), dtype=np.float32),  # asha-shaped incumbent
+        None,
+        q,
+        fixed_tail_cols=1,
+        **kw,
+    )
+    assert rows.shape == (q, width - 1)
+    assert _suggest_step._cache_size() == before, (
+        "prewarmed entry not hit: the dummy call's signature diverged from "
+        "the fixed-tail caller's"
+    )
+
+
+def test_prewarmer_dedup_and_failure_swallowed():
+    warmer = BucketPrewarmer()
+    calls = []
+    assert warmer.maybe_start("k1", lambda: calls.append(1)) is True
+    warmer.wait()
+    assert warmer.maybe_start("k1", lambda: calls.append(2)) is False
+    warmer.wait()
+    assert calls == [1]
+
+    def boom():
+        raise RuntimeError("compile failed")
+
+    assert warmer.maybe_start("k2", boom) is True
+    warmer.wait()  # must not raise
+    assert not warmer.in_flight
