@@ -535,6 +535,110 @@ def _safe_trace(trace_out):
         return None
 
 
+def main_chaos(rounds=6, q=8, seed=11):
+    """Chaos smoke: producer rounds against fault-injected storage.
+
+    Runs ``rounds`` produce+complete rounds twice — once over a
+    FaultyDB-wrapped SQLite store, once over a loopback network server
+    behind the TCP fault proxy (with a mid-run connection drop) — under a
+    seeded schedule covering every fault class, then prints ONE json line
+    with per-round ``storage.retries``/``reconnects``/injected-fault
+    counts and the invariant auditor's verdict.  Converging through the
+    schedule with zero audit violations IS the check (hard asserts);
+    the numbers trend the retry tax across BENCH_* files."""
+    import os
+    import tempfile
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.storage.base import DocumentStorage
+    from orion_tpu.storage.faults import FaultProxy, FaultSchedule, FaultyDB
+    from orion_tpu.storage.sqlitedb import SQLiteDB
+    from orion_tpu.testing import drive_chaos_experiment
+
+    retry = {"max_attempts": 6, "base_delay": 0.005, "max_delay": 0.05}
+
+    def run_rounds(storage, name, proxy=None):
+        # ONE chaos driver shared with tests/functional/test_chaos.py
+        # (reserve -> complete with transient backoff, bounded by a
+        # convergence deadline, sweep + audit epilogue) so the bench's
+        # smoke and the suite's assertions cannot drift apart.
+        _exp, report = drive_chaos_experiment(
+            storage,
+            name=f"bench-chaos-{name}",
+            priors={f"x{i}": "uniform(0, 1)" for i in range(4)},
+            max_trials=rounds * q,
+            pool_size=q,
+            seed=seed,
+            proxy=proxy,
+            drop_every=3 if proxy is not None else 0,
+            deadline=180.0,
+        )
+        return report
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    payload = {"metric": "chaos smoke", "rounds": rounds, "q": q, "backends": {}}
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-chaos-") as tmpdir:
+            # --- sqlite through FaultyDB -----------------------------------
+            schedule = FaultSchedule(
+                seed=seed,
+                plan={3: "error", 7: "latency", 11: "reply_lost", 15: "kill"},
+                rates={"error": 0.02, "latency": 0.02},
+                latency=0.002,
+                max_faults=12,
+            )
+            inner = SQLiteDB(os.path.join(tmpdir, "chaos.sqlite"))
+            storage = DocumentStorage(FaultyDB(inner, schedule), retry=retry)
+            before = tel.TELEMETRY.counter_value("storage.retries")
+            report = run_rounds(storage, "sqlite")
+            retries = tel.TELEMETRY.counter_value("storage.retries") - before
+            assert report.ok, report.summary()
+            assert retries > 0, "faults fired but nothing retried"
+            payload["backends"]["sqlite"] = {
+                "storage_retries_per_round": round(retries / rounds, 2),
+                "faults_injected": dict(schedule.injected),
+                "audit_violations": len(report.violations),
+            }
+            inner.close()
+
+            # --- network through the fault proxy ---------------------------
+            from orion_tpu.storage.netdb import DBServer, NetworkDB
+
+            server = DBServer(port=0)
+            server.db = FaultyDB(
+                server.db,
+                FaultSchedule(seed=seed + 1, rates={"error": 0.02}, max_faults=8),
+            )
+            host, port = server.serve_background()
+            proxy = FaultProxy(host, port)
+            phost, pport = proxy.serve_background()
+            client = NetworkDB(host=phost, port=pport, timeout=10.0, idle_probe=0.05)
+            net_storage = DocumentStorage(client, retry=retry)
+            before = tel.TELEMETRY.counter_value("storage.retries")
+            try:
+                report = run_rounds(net_storage, "network", proxy=proxy)
+                retries = tel.TELEMETRY.counter_value("storage.retries") - before
+                assert report.ok, report.summary()
+                payload["backends"]["network"] = {
+                    "storage_retries_per_round": round(retries / rounds, 2),
+                    "reconnects_per_round": round(client.reconnects / rounds, 2),
+                    "faults_injected": dict(server.db.faults_injected),
+                    "proxy_drops": proxy.connections_dropped,
+                    "audit_violations": len(report.violations),
+                }
+                assert client.reconnects >= 1
+            finally:
+                client._close()
+                proxy.stop()
+                server.shutdown()
+                server.server_close()
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    print(json.dumps(payload))
+
+
 def main_smoke(trace_out="bench_trace.json"):
     """Tiny-n schema smoke: the same JSON line shape in seconds instead of
     minutes — no regret parity, no sklearn anchor, no device
@@ -585,4 +689,7 @@ if __name__ == "__main__":
         if at + 1 >= len(argv):
             sys.exit("bench.py: --trace-out requires a path argument")
         out = argv[at + 1]
-    main(smoke="--smoke" in argv, trace_out=out)
+    if "--chaos" in argv:
+        main_chaos()
+    else:
+        main(smoke="--smoke" in argv, trace_out=out)

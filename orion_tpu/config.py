@@ -82,7 +82,11 @@ DEFAULTS = {
     "heartbeat": 120.0,
     "max_idle_time": 60.0,
     "user_script_config": "config",
-    "storage": {"type": "pickled", "path": "orion_tpu_db.pkl"},
+    # storage.retry holds the unified retry-policy knobs (max_attempts,
+    # base_delay, max_delay, multiplier, jitter, deadline — the
+    # RetryPolicy defaults apply for any omitted key; docs/robustness.md);
+    # `retry: false` disables storage-level retries entirely.
+    "storage": {"type": "pickled", "path": "orion_tpu_db.pkl", "retry": {}},
     # Framework telemetry (orion_tpu.telemetry): None = leave the
     # process-wide registry as the ORION_TPU_TELEMETRY env var set it;
     # true/false here overrides (the CLI applies it in load_cli_config).

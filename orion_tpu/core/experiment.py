@@ -218,6 +218,16 @@ class Experiment:
     def is_broken(self):
         return self._storage.count_broken_trials(self._id) >= self.max_broken
 
+    def audit(self, lost_timeout=None):
+        """Run the storage invariant auditor over this experiment's trials
+        (``orion_tpu.storage.audit``); the orphaned-reservation threshold
+        defaults to this experiment's heartbeat window."""
+        from orion_tpu.storage.audit import audit_experiment
+
+        return audit_experiment(
+            self._storage, self, lost_timeout=lost_timeout
+        )
+
     # --- stats --------------------------------------------------------------
     def stats(self):
         """Best trial + counts + duration (reference `experiment.py:419-467`)."""

@@ -11,12 +11,12 @@ a `max_idle_time` guard against algorithms that stop producing new points.
 import copy
 import inspect
 import logging
-import random as _random
 import time
 
 import numpy as np
 
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Result, Trial
+from orion_tpu.storage.retry import RetryPolicy
 from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import (
     AlgorithmExhausted,
@@ -83,6 +83,9 @@ class Producer:
             self.algorithm, "uses_observe_cube", True
         ) and _observe_accepts_cube(self.algorithm)
         self.failure_count = 0
+        self._backoff_policy = RetryPolicy(
+            base_delay=0.01, max_delay=0.5, jitter=0.5, deadline=None
+        )
         self._n_in_flight = 0  # status == reserved (someone is executing)
         self._n_reservable = 0  # new/suspended/interrupted (worker can consume)
         self._pending_timings = []
@@ -590,8 +593,12 @@ class Producer:
         self._sleep_backoff()
 
     def _sleep_backoff(self):
-        sleep = max(0.0, _random.gauss(0.01 * (1 + self.failure_count), 0.005))
-        time.sleep(min(sleep, 0.5))
+        # The unified backoff policy (storage/retry.py): exponential from
+        # 10ms, capped at the same 0.5s ceiling the old gaussian sleep
+        # had, jittered so concurrent producers de-synchronize.
+        self._backoff_policy.sleep(
+            self.failure_count, op="producer.backoff", span="producer.backoff"
+        )
         self.failure_count += 1
 
 

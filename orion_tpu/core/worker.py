@@ -11,34 +11,58 @@ lives inside each algorithm's jitted suggest step.
 
 import io
 import logging
+import time
 
 from orion_tpu.core.consumer import Consumer
 from orion_tpu.core.experiment import DEFAULT_HEARTBEAT, DEFAULT_MAX_IDLE_TIME
 from orion_tpu.core.producer import Producer
+from orion_tpu.storage.retry import RetryPolicy, is_transient
 from orion_tpu.utils.exceptions import (
     AlgorithmExhausted,
     BrokenExperiment,
+    DatabaseError,
     SampleTimeout,
     WaitingForTrials,
 )
 
 log = logging.getLogger(__name__)
 
+#: Production rounds reserve_trial attempts before declaring the queue dry.
+MAX_RESERVE_ROUNDS = 10
 
-def reserve_trial(experiment, producer, _depth=0):
+
+def reserve_trial(experiment, producer, max_rounds=MAX_RESERVE_ROUNDS, policy=None):
     """Reserve a trial, producing a fresh batch when none is pending
-    (reference `worker/__init__.py:24-39`)."""
-    trial = experiment.reserve_trial()
-    if trial is not None:
-        return trial
-    if _depth >= 10:
-        raise WaitingForTrials(
-            "no trial could be reserved after repeated production rounds"
+    (reference `worker/__init__.py:24-39`).
+
+    Iterative, not recursive: a production storm (concurrent workers
+    stealing every produced batch) used to build a depth-10 recursion
+    whose traceback pointed at the recursion instead of the contention —
+    and retried back-to-back with no spacing.  The loop retries up to
+    ``max_rounds`` production rounds with the unified backoff policy
+    between empty-handed rounds, so contention storms thin out instead of
+    stampeding."""
+    if policy is None:
+        policy = RetryPolicy(
+            max_attempts=max_rounds + 1, base_delay=0.01, max_delay=0.5,
+            deadline=None,
         )
-    log.debug("no pending trials; producing a new batch")
-    producer.update()
-    producer.produce()
-    return reserve_trial(experiment, producer, _depth=_depth + 1)
+    for attempt in range(max_rounds + 1):
+        trial = experiment.reserve_trial()
+        if trial is not None:
+            return trial
+        if attempt >= max_rounds:
+            break
+        if attempt:
+            # First empty round just produces (the common cold-start);
+            # repeated ones mean contention — space them out.
+            policy.sleep(attempt - 1, op="reserve_trial", span="worker.backoff")
+        log.debug("no pending trials; producing a new batch")
+        producer.update()
+        producer.produce()
+    raise WaitingForTrials(
+        f"no trial could be reserved after {max_rounds} production rounds"
+    )
 
 
 def workon(
@@ -81,19 +105,74 @@ def workon(
 
 def _workon_loop(experiment, producer, consumer, worker_trials, on_error):
     iterations = 0
+    # Graceful degradation under storage hiccups: a transient failure that
+    # exhausted the storage layer's own retry policy backs the WORKER off
+    # (up to max_idle_time of consecutive failure) instead of crashing it —
+    # a worker that dies on a 20s storage blip abandons its reserved trial
+    # to the lost-trial sweep and shrinks the fleet.  Fatal (semantic)
+    # errors still raise immediately; the window resets on any success.
+    degrade_policy = RetryPolicy(
+        max_attempts=10**9, base_delay=0.1, max_delay=5.0, deadline=None
+    )
+    degrade_state = {"since": None, "count": 0}
+
+    def _degrade(exc, where):
+        """Absorb one transient failure (backoff + True) or decide it must
+        raise (False): fatal errors, or a failure streak past
+        max_idle_time.  Only DatabaseError-family transients qualify:
+        every backend wraps its infrastructure failures in DatabaseError,
+        while a raw OSError here is NOT storage — it is the user's script
+        failing to launch (FileNotFoundError from Popen) and must crash
+        with its real traceback, not be retried as a 'storage blip'."""
+        if not (isinstance(exc, DatabaseError) and is_transient(exc)):
+            return False
+        now = time.monotonic()
+        since = degrade_state["since"] or now
+        degrade_state["since"] = since
+        if now - since > producer.max_idle_time:
+            log.error(
+                "storage has been failing for %.1fs (> max_idle_time); "
+                "giving up: %s",
+                now - since,
+                exc,
+            )
+            return False
+        log.warning(
+            "transient storage failure during %s (attempt %d, backing off): %s",
+            where,
+            degrade_state["count"] + 1,
+            exc,
+        )
+        degrade_policy.sleep(
+            degrade_state["count"], op=f"worker.{where}", span="worker.backoff"
+        )
+        degrade_state["count"] += 1
+        return True
     while iterations < worker_trials:
-        if experiment.is_broken:
+        # The status reads are storage round trips too: during an outage the
+        # degrade path above would absorb a reserve failure only for the
+        # next loop-top is_broken/is_done read to crash the worker anyway.
+        try:
+            broken = experiment.is_broken
+            done = False if broken else experiment.is_done
+        except Exception as exc:
+            if not _degrade(exc, "status"):
+                raise
+            continue
+        if broken:
             log.error(
                 "Experiment %s is broken (>= %s broken trials); stopping.",
                 experiment.name,
                 experiment.max_broken,
             )
             raise BrokenExperiment(f"experiment {experiment.name} has too many broken trials")
-        if experiment.is_done:
+        if done:
             log.info("Experiment %s is done.", experiment.name)
             break
         try:
             trial = reserve_trial(experiment, producer)
+            degrade_state["since"] = None
+            degrade_state["count"] = 0
         except AlgorithmExhausted:
             # A finite algorithm ran out of points with nothing in flight:
             # every registered trial is consumed and no observation can
@@ -104,12 +183,34 @@ def _workon_loop(experiment, producer, consumer, worker_trials, on_error):
                 experiment.name,
             )
             break
-        except (SampleTimeout, WaitingForTrials):
-            if experiment.is_done:
-                break
-            raise
+        except (SampleTimeout, WaitingForTrials) as dry:
+            try:
+                if experiment.is_done:
+                    break
+            except Exception as exc:
+                if not _degrade(exc, "status"):
+                    raise
+                continue
+            raise dry
+        except Exception as exc:
+            if not _degrade(exc, "reserve"):
+                raise
+            continue
         log.debug("Consuming trial %s", trial.id)
-        success = consumer.consume(trial)
+        try:
+            success = consumer.consume(trial)
+        except Exception as exc:
+            # An observe-side storage failure (pushing results/status) that
+            # outlived the storage policy: the trial stays reserved and the
+            # lost-trial sweep will recover it — back the worker off rather
+            # than killing it (the observation is re-earned by the re-run,
+            # never silently dropped).  KeyboardInterrupt and semantic
+            # errors propagate as before.
+            if not _degrade(exc, "consume"):
+                raise
+            continue
+        degrade_state["since"] = None
+        degrade_state["count"] = 0
         if not success and on_error is not None:
             on_error(trial)
         iterations += 1

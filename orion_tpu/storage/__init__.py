@@ -15,6 +15,7 @@ Intra-suggest parallelism (on-device vmap/shard_map over a TPU mesh) is a
 *different* layer — see ``orion_tpu.parallel``.
 """
 
+from orion_tpu.storage.audit import AuditReport, audit_experiment, audit_storage
 from orion_tpu.storage.base import (
     BaseStorage,
     DocumentStorage,
@@ -25,17 +26,27 @@ from orion_tpu.storage.base import (
 )
 from orion_tpu.storage.documents import MemoryDB
 from orion_tpu.storage.backends import PickledDB
+from orion_tpu.storage.faults import FaultProxy, FaultSchedule, FaultyDB
 from orion_tpu.storage.netdb import DBServer, NetworkDB
+from orion_tpu.storage.retry import RetryPolicy, is_transient
 
 __all__ = [
+    "AuditReport",
     "BaseStorage",
     "DBServer",
     "DocumentStorage",
+    "FaultProxy",
+    "FaultSchedule",
+    "FaultyDB",
     "MemoryDB",
     "NetworkDB",
     "PickledDB",
     "ReadOnlyStorage",
+    "RetryPolicy",
+    "audit_experiment",
+    "audit_storage",
     "create_storage",
     "get_storage",
+    "is_transient",
     "setup_storage",
 ]

@@ -16,6 +16,7 @@ import time
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Trial
 from orion_tpu.storage.backends import PickledDB
 from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.storage.retry import MODE_ALWAYS, MODE_UNAPPLIED, create_retry_policy
 from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import DatabaseError, FailedUpdate
 
@@ -172,23 +173,39 @@ _BACKEND_LABELS = {
 _BACKEND_COUNTER_ATTRS = ("txn_count", "wire_requests", "round_trips", "reconnects")
 
 
-def _traced(op, span_name=None):
+def _traced(op, span_name=None, retry=MODE_ALWAYS):
     """Time a DocumentStorage protocol op into the telemetry registry: a
     ``storage.{op}`` span (overridable — ``register_trials`` reports as
     ``storage.commit``, the produce round's write) plus a per-backend
     per-op latency histogram ``storage.{backend}.{op}``.  Disabled
-    telemetry costs one attribute check."""
+    telemetry costs one attribute check.
+
+    ``retry`` applies the storage instance's unified
+    :class:`~orion_tpu.storage.retry.RetryPolicy` around the op (the mode
+    says whether the op converges under re-application; None opts out).
+    Retries happen INSIDE the span/histogram window, so the recorded op
+    latency is what the caller actually waited — the separate
+    ``storage.retries`` counter says how much of it was retry."""
 
     def decorate(fn):
         name = span_name or f"storage.{op}"
 
         @functools.wraps(fn)
         def wrapper(self, *args, **kwargs):
+            policy = self._retry
+            if policy is not None and retry is not None:
+                def run():
+                    return policy.run(
+                        lambda: fn(self, *args, **kwargs), op=op, mode=retry
+                    )
+            else:
+                def run():
+                    return fn(self, *args, **kwargs)
             if not TELEMETRY.enabled:
-                return fn(self, *args, **kwargs)
+                return run()
             t0 = time.perf_counter()
             try:
-                return fn(self, *args, **kwargs)
+                return run()
             finally:
                 duration = time.perf_counter() - t0
                 backend = self._backend_label
@@ -205,11 +222,36 @@ def _traced(op, span_name=None):
     return decorate
 
 
+def _retrying(op, mode=MODE_ALWAYS):
+    """Retry-only wrapper (no span) for the protocol ops outside the traced
+    set — reads and auxiliary writes share the same policy and transient
+    classification as the hot-path ops, they just don't each earn a
+    telemetry stream."""
+
+    def decorate(fn):
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            policy = self._retry
+            if policy is None:
+                return fn(self, *args, **kwargs)
+            return policy.run(lambda: fn(self, *args, **kwargs), op=op, mode=mode)
+
+        return wrapper
+
+    return decorate
+
+
 class DocumentStorage(BaseStorage):
     """Protocol over any AbstractDB-style document backend."""
 
-    def __init__(self, db):
+    def __init__(self, db, retry=None):
         self._db = db
+        # Unified retry policy (storage/retry.py): default ON with modest
+        # settings — every protocol op below shares one backoff/deadline/
+        # classification contract across all four backends.  ``retry``
+        # accepts a RetryPolicy, a ``storage.retry`` config dict, or
+        # False to disable (raw pre-policy behavior).
+        self._retry = create_retry_policy(retry)
         self._backend_label = _BACKEND_LABELS.get(
             type(db).__name__, type(db).__name__.lower()
         )
@@ -235,15 +277,20 @@ class DocumentStorage(BaseStorage):
         self._db.ensure_indexes(INDEX_SPECS)
 
     # --- experiments --------------------------------------------------------
+    @_retrying("create_experiment")
     def create_experiment(self, config):
         """Insert a new experiment config; DuplicateKeyError if (name, version)
-        already exists — callers translate that into a RaceCondition retry."""
+        already exists — callers translate that into a RaceCondition retry.
+        Retry-converging: a re-send of an applied-but-unacknowledged create
+        surfaces as that same DuplicateKeyError, which the builder already
+        treats as a lost creation race and resolves by reloading."""
         config = dict(config)
         config.setdefault("version", 1)
         _id = self._db.write("experiments", config)
         config["_id"] = _id
         return config
 
+    @_retrying("update_experiment")
     def update_experiment(self, experiment=None, uid=None, where=None, **kwargs):
         query = dict(where or {})
         if uid is not None:
@@ -258,6 +305,7 @@ class DocumentStorage(BaseStorage):
             )
         return self._db.write("experiments", kwargs, query=query)
 
+    @_retrying("fetch_experiments")
     def fetch_experiments(self, query, projection=None):
         return self._db.read("experiments", query, projection)
 
@@ -269,11 +317,13 @@ class DocumentStorage(BaseStorage):
         self._db.write("trials", trial.to_dict())
         return trial
 
+    @_retrying("register_lie")
     def register_lie(self, trial):
         trial.submit_time = trial.submit_time or time.time()
         self._db.write("lying_trials", trial.to_dict())
         return trial
 
+    @_retrying("fetch_lies")
     def fetch_lies(self, experiment):
         docs = self._db.read("lying_trials", {"experiment": _exp_id(experiment)})
         return [Trial.from_dict(d) for d in docs]
@@ -454,6 +504,7 @@ class DocumentStorage(BaseStorage):
         docs.sort(key=_trial_doc_order)
         return [Trial.from_dict(d) for d in docs]
 
+    @_retrying("read_trial_docs")
     def read_trial_docs(self, uid, ids=None, projection=None):
         """Raw trial documents for an experiment, optionally id-filtered and
         projected.  The supported read path for consumers that need
@@ -514,6 +565,7 @@ class DocumentStorage(BaseStorage):
         docs = sorted(by_id.values(), key=_trial_doc_order)
         return [Trial.from_dict(d) for d in docs], n_completed
 
+    @_retrying("fetch_trials_by_status")
     def fetch_trials_by_status(self, experiment, status):
         statuses = [status] if isinstance(status, str) else list(status)
         docs = self._db.read(
@@ -522,12 +574,13 @@ class DocumentStorage(BaseStorage):
         )
         return [Trial.from_dict(d) for d in docs]
 
+    @_retrying("get_trial")
     def get_trial(self, trial=None, uid=None):
         _id = uid if uid is not None else trial.id
         docs = self._db.read("trials", {"_id": _id})
         return Trial.from_dict(docs[0]) if docs else None
 
-    @_traced("set_trial_status")
+    @_traced("set_trial_status", retry=MODE_UNAPPLIED)
     def set_trial_status(self, trial, status, was=None):
         """Compare-and-swap status update (reference `legacy.py:223-243`).
 
@@ -535,12 +588,41 @@ class DocumentStorage(BaseStorage):
         ``was`` (defaulting to the caller's in-memory view, so a concurrent
         transition by another worker raises FailedUpdate instead of being
         silently overwritten).
+
+        The CAS does NOT converge under blind re-application (a retried
+        swap that already applied reports a spurious FailedUpdate), so the
+        retry mode is ``unapplied`` and ambiguous losses verify-then-
+        converge here: a re-read showing the target status means the lost
+        attempt applied (success); one showing the guard status means it
+        did not (the ambiguity is cleared and the policy may retry);
+        anything else re-raises the ambiguity.
         """
-        query = {"_id": trial.id, "status": was if was is not None else trial.status}
+        guard = was if was is not None else trial.status
+        query = {"_id": trial.id, "status": guard}
         update = {"status": status}
         if status in ("completed", "interrupted", "broken"):
             update["end_time"] = time.time()
-        doc = self._db.read_and_write("trials", query, update)
+        try:
+            doc = self._db.read_and_write("trials", query, update)
+        except DatabaseError as exc:
+            if not getattr(exc, "maybe_applied", False):
+                raise
+            try:
+                current = self._db.read("trials", {"_id": trial.id})
+            except Exception:
+                # The verify read failed too, so the ambiguity STANDS —
+                # re-raise the original ambiguous error.  Letting the
+                # read's own (possibly non-ambiguous) failure propagate
+                # would hand the retry policy a transient it happily
+                # re-runs, blind-re-executing the non-converging CAS.
+                raise exc from None
+            stored = current[0].get("status") if current else None
+            if stored == status:
+                trial.status = status
+                return Trial.from_dict(current[0])
+            if stored == guard:
+                exc.maybe_applied = False  # provably not applied: retriable
+            raise
         if doc is None:
             raise FailedUpdate(
                 f"trial {trial.id} not updated to {status!r} (was={was!r})"
@@ -558,6 +640,7 @@ class DocumentStorage(BaseStorage):
         if doc is None:
             raise FailedUpdate(f"trial {trial.id} is no longer reserved")
 
+    @_retrying("fetch_lost_trials")
     def fetch_lost_trials(self, experiment, timeout):
         """Reserved trials whose worker stopped heartbeating (crashed/killed)."""
         threshold = time.time() - timeout
@@ -571,6 +654,7 @@ class DocumentStorage(BaseStorage):
         )
         return [Trial.from_dict(d) for d in docs]
 
+    @_retrying("push_trial_results")
     def push_trial_results(self, trial):
         doc = self._db.read_and_write(
             "trials",
@@ -599,11 +683,13 @@ class DocumentStorage(BaseStorage):
         trial.status = "completed"
         return trial
 
+    @_retrying("count_completed_trials")
     def count_completed_trials(self, experiment):
         return self._db.count(
             "trials", {"experiment": _exp_id(experiment), "status": "completed"}
         )
 
+    @_retrying("count_broken_trials")
     def count_broken_trials(self, experiment):
         return self._db.count(
             "trials", {"experiment": _exp_id(experiment), "status": "broken"}
@@ -718,6 +804,7 @@ class DocumentStorage(BaseStorage):
         docs.sort(key=lambda d: d.get("ts") or 0.0)
         return docs
 
+    @_retrying("fetch_noncompleted_trials")
     def fetch_noncompleted_trials(self, experiment):
         docs = self._db.read(
             "trials",
@@ -831,19 +918,30 @@ def create_storage(config=None):
     """Build a storage instance from a config dict.
 
     ``{"type": "memory"}`` or ``{"type": "pickled", "path": ...}``.
+    A ``retry`` sub-dict tunes the unified retry policy knobs
+    (``max_attempts``/``base_delay``/``max_delay``/``multiplier``/
+    ``jitter``/``deadline`` — docs/robustness.md); ``retry: false``
+    disables retries entirely.
     """
     config = dict(config or {})
+    retry = config.get("retry")
     db_type = config.get("type", "pickled")
     if db_type in ("memory", "ephemeral", "ephemeraldb"):
-        return DocumentStorage(MemoryDB())
+        return DocumentStorage(MemoryDB(), retry=retry)
     if db_type in ("pickled", "pickleddb"):
         path = config.get("path", "orion_tpu_db.pkl")
-        return DocumentStorage(PickledDB(path, lock_timeout=config.get("lock_timeout", 60.0)))
+        return DocumentStorage(
+            PickledDB(path, lock_timeout=config.get("lock_timeout", 60.0)),
+            retry=retry,
+        )
     if db_type in ("sqlite", "sqlite3"):
         from orion_tpu.storage.sqlitedb import SQLiteDB
 
         path = config.get("path", "orion_tpu_db.sqlite")
-        return DocumentStorage(SQLiteDB(path, timeout=config.get("lock_timeout", 60.0)))
+        return DocumentStorage(
+            SQLiteDB(path, timeout=config.get("lock_timeout", 60.0)),
+            retry=retry,
+        )
     if db_type in ("network", "netdb"):
         from orion_tpu.storage.netdb import NetworkDB
 
@@ -854,7 +952,8 @@ def create_storage(config=None):
                 port=port,
                 timeout=config.get("timeout", 60.0),
                 secret=_resolve_network_secret(config),
-            )
+            ),
+            retry=retry,
         )
     raise DatabaseError(f"Unknown storage type {db_type!r}")
 

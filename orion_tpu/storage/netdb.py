@@ -144,7 +144,13 @@ def _encode_outcome(result):
         error = "KeyError"
     else:
         error = type(result).__name__
-    return {"ok": False, "error": error, "message": str(result)}
+    out = {"ok": False, "error": error, "message": str(result)}
+    if getattr(result, "maybe_applied", False):
+        # The applied-or-not-unknowable marker must survive the wire, or
+        # the client-side retry policy would blind-resend non-converging
+        # mutations a failing server may already have applied.
+        out["maybe_applied"] = True
+    return out
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -288,9 +294,12 @@ class _Handler(socketserver.StreamRequestHandler):
             if any(op in _MUTATING_OPS for op, _, _ in normalized):
                 self.server.persist_snapshot()
             return {"ok": True, "result": [_encode_outcome(r) for r in results]}
-        except Exception as exc:  # pragma: no cover - defensive
+        except Exception as exc:
+            # Whole-batch failure (e.g. a fault-injected mid-batch kill):
+            # encode through the one shared path so markers like
+            # maybe_applied survive the wire.
             log.exception("batch of %d ops failed", len(normalized))
-            return {"ok": False, "error": type(exc).__name__, "message": str(exc)}
+            return _encode_outcome(exc)
 
 
 class DBServer(socketserver.ThreadingTCPServer):
@@ -407,6 +416,8 @@ def _translate(response, raise_errors=True):
         "AuthenticationError": AuthenticationError,
     }.get(error)
     exc = exc_cls(message) if exc_cls else DatabaseError(f"{error}: {message}")
+    if response.get("maybe_applied") and isinstance(exc, DatabaseError):
+        exc.maybe_applied = True
     if raise_errors:
         raise exc
     return exc
@@ -587,10 +598,16 @@ class NetworkDB:
                     sent = self._sock is not None
                     self._close()
                     if attempt or (sent and not retriable):
-                        raise DatabaseError(
+                        error = DatabaseError(
                             f"connection to {self.host}:{self.port} lost during "
                             f"{op!r}: {exc}"
-                        ) from exc
+                        )
+                        # The request may have reached the server before the
+                        # connection died: applied-or-not is unknowable, and
+                        # the unified retry policy must not blindly re-send
+                        # non-converging mutations (storage/retry.py).
+                        error.maybe_applied = sent
+                        raise error from exc
         return _translate(response)
 
     def pipeline(self, ops):
@@ -667,10 +684,14 @@ class NetworkDB:
             if reader_error:
                 exc = reader_error[0]
                 self._close()
-                raise DatabaseError(
+                error = DatabaseError(
                     f"connection to {self.host}:{self.port} lost during "
                     f"pipeline of {len(ops)} ops: {exc}"
-                ) from exc
+                )
+                # A prefix of the pipelined ops may have applied before the
+                # connection died (the server dispatches line by line).
+                error.maybe_applied = True
+                raise error from exc
             self._last_used = time.monotonic()
             self.round_trips += 1
             self.wire_requests += len(ops)
@@ -761,10 +782,12 @@ class NetworkDB:
                     # Read phase: the server may or may not have applied the
                     # batch — same contract as a lost in-flight _call.
                     self._close()
-                    raise DatabaseError(
+                    error = DatabaseError(
                         f"connection to {self.host}:{self.port} lost during "
                         f"batch of {len(ops)} ops: {exc}"
-                    ) from exc
+                    )
+                    error.maybe_applied = True
+                    raise error from exc
                 self._last_used = time.monotonic()
                 self.round_trips += 1
                 self.wire_requests += 1

@@ -94,6 +94,89 @@ class DumbAlgo(BaseAlgorithm):
         return self.done
 
 
+def drive_chaos_experiment(
+    storage,
+    name="chaos",
+    priors=None,
+    algorithms=None,
+    max_trials=9,
+    pool_size=3,
+    seed=1,
+    heartbeat=2.0,
+    max_idle_time=30.0,
+    proxy=None,
+    drop_every=0,
+    deadline=120.0,
+):
+    """THE shared chaos-run driver (docs/robustness.md): drive an
+    experiment to completion the way a worker does — reserve, complete,
+    tolerate transient storage failures with a short backoff — against a
+    (typically fault-injected) storage, then sweep lost trials and audit.
+
+    Used by both the chaos suite (tests/functional/test_chaos.py) and
+    ``bench.py --chaos`` so the two cannot drift apart; shipped in the
+    package so third-party backend authors can chaos-test their own
+    storage the same way.  ``proxy``/``drop_every`` schedule connection
+    drops through a :class:`~orion_tpu.storage.faults.FaultProxy` every N
+    iterations; ``deadline`` bounds the whole run (TimeoutError on
+    non-convergence — a hung chaos run must fail loudly, not spin).
+
+    Returns ``(experiment, audit_report)``.
+    """
+    import time
+
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.core.trial import Result
+    from orion_tpu.core.worker import reserve_trial
+    from orion_tpu.storage.audit import audit_experiment
+    from orion_tpu.storage.retry import is_transient
+
+    experiment = build_experiment(
+        storage,
+        name,
+        priors=dict(priors or {"/x": "uniform(0, 1)", "/y": "uniform(0, 1)"}),
+        algorithms=algorithms or {"random": {"seed": seed}},
+        max_trials=max_trials,
+        pool_size=pool_size,
+        metadata={"user": "chaos"},
+    ).instantiate(seed=seed)
+    experiment.heartbeat = heartbeat  # reply-lost orphans recover fast
+    producer = Producer(experiment, max_idle_time=max_idle_time)
+    producer.update()
+    stop_at = time.monotonic() + deadline
+    iterations = 0
+    while not experiment.is_done:
+        if time.monotonic() >= stop_at:
+            raise TimeoutError(
+                f"chaos run failed to converge within {deadline}s "
+                f"({iterations} iterations)"
+            )
+        iterations += 1
+        if proxy is not None and drop_every and iterations % drop_every == 0:
+            proxy.drop_all()  # scheduled "server restart"
+        try:
+            trial = reserve_trial(experiment, producer)
+            value = float(next(iter(trial.params.values())))
+            experiment.update_completed_trial(
+                trial, [Result("obj", "objective", value)]
+            )
+        except Exception as exc:
+            # The worker loop's degradation contract: transient failures
+            # that exhausted the storage policy back off and retry; real
+            # bugs raise.
+            if not is_transient(exc):
+                raise
+            time.sleep(0.01)
+    # Recover any reply-lost orphaned reservations the run left behind —
+    # the sweep is the production path for exactly this state.
+    time.sleep(0.05)
+    experiment.fix_lost_trials()
+    report = audit_experiment(
+        experiment.storage, experiment, lost_timeout=experiment.heartbeat
+    )
+    return experiment, report
+
+
 class OrionState(contextlib.AbstractContextManager):
     """Temporary, fully-populated orion-tpu stack for tests.
 

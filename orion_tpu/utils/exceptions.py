@@ -27,7 +27,17 @@ class RaceCondition(OrionTPUError):
 
 
 class DatabaseError(OrionTPUError):
-    """Generic storage-backend failure."""
+    """Generic storage-backend failure.
+
+    ``maybe_applied`` marks the applied-or-not-unknowable failures: the
+    operation MAY have been durably applied before the failure surfaced
+    (the network driver's lost-in-flight-mutation case, a fault-injected
+    reply loss).  The unified retry policy (``storage/retry.py``) only
+    re-runs such a failure for operations that converge under
+    re-application; everything else surfaces the ambiguity.  Class
+    default False; raisers set the instance attribute."""
+
+    maybe_applied = False
 
 
 class DuplicateKeyError(DatabaseError):

@@ -1,0 +1,163 @@
+"""Chaos suite: experiments must complete under seeded fault schedules.
+
+Runs a small experiment to completion on every backend while a
+deterministic :class:`~orion_tpu.storage.faults.FaultSchedule` injects one
+of each fault class (raise-before-apply, apply-then-reply-lost, latency
+spike, mid-batch kill) into the document-DB layer — plus, on the network
+backend, real connection drops through the TCP
+:class:`~orion_tpu.storage.faults.FaultProxy` so the driver's reconnect
+paths run, not mocks.  The run must converge through the unified retry
+policy, and the storage invariant auditor must come back clean: zero
+duplicated trials, zero lost observations, no orphaned reservations.
+``storage.retries > 0`` proves the faults actually fired through the
+retry path rather than being scheduled past the end of the run.
+
+Tier-1 keeps the tiny schedules; the long high-rate soak is marked slow.
+"""
+
+import pytest
+
+from orion_tpu.storage.base import DocumentStorage
+from orion_tpu.storage.documents import MemoryDB
+from orion_tpu.storage.faults import (
+    FAULT_KINDS,
+    FaultProxy,
+    FaultSchedule,
+    FaultyDB,
+)
+from orion_tpu.telemetry import TELEMETRY
+from orion_tpu.testing import drive_chaos_experiment
+
+BACKENDS = ["memory", "pickled", "sqlite", "network"]
+
+#: Retry knobs for chaos runs: tight backoff so the suite stays fast, but
+#: enough attempts to ride out back-to-back scheduled faults.
+RETRY = {"max_attempts": 6, "base_delay": 0.005, "max_delay": 0.05, "deadline": 30.0}
+
+#: One pinned fault per round class early in the run (op indices), with
+#: seeded random extras on top — deterministic AND guaranteed coverage.
+TINY_PLAN = {3: "error", 8: "latency", 13: "reply_lost", 17: "kill"}
+TINY_RATES = {"error": 0.03, "reply_lost": 0.02, "latency": 0.03, "kill": 0.02}
+
+
+@pytest.fixture
+def telemetry_enabled():
+    was = TELEMETRY.enabled
+    TELEMETRY.enable()
+    yield TELEMETRY
+    if not was:
+        TELEMETRY.disable()
+
+
+def _make_faulty_storage(backend, tmp_path, schedule):
+    """(storage, cleanup, proxy_or_None) with the schedule installed at the
+    document-DB layer (in-process backends) or server-side behind a fault
+    proxy (network)."""
+    if backend == "memory":
+        return DocumentStorage(FaultyDB(MemoryDB(), schedule), retry=RETRY), None, None
+    if backend == "pickled":
+        from orion_tpu.storage.backends import PickledDB
+
+        db = FaultyDB(PickledDB(str(tmp_path / "chaos.pkl")), schedule)
+        return DocumentStorage(db, retry=RETRY), None, None
+    if backend == "sqlite":
+        from orion_tpu.storage.sqlitedb import SQLiteDB
+
+        inner = SQLiteDB(str(tmp_path / "chaos.sqlite"))
+        storage = DocumentStorage(FaultyDB(inner, schedule), retry=RETRY)
+        return storage, inner.close, None
+    # network: faults injected server-side (so the error crosses the real
+    # wire protocol) AND the client connects through the fault proxy so
+    # scheduled connection drops exercise genuine reconnects.
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+
+    server = DBServer(port=0)
+    server.db = FaultyDB(server.db, schedule)
+    host, port = server.serve_background()
+    proxy = FaultProxy(host, port)
+    phost, pport = proxy.serve_background()
+    client = NetworkDB(host=phost, port=pport, timeout=10.0, idle_probe=0.05)
+    storage = DocumentStorage(client, retry=RETRY)
+
+    def cleanup():
+        client._close()
+        proxy.stop()
+        server.shutdown()
+        server.server_close()
+
+    return storage, cleanup, proxy
+
+
+def _assert_chaos_outcome(exp, report, schedule, max_trials, registry,
+                          retries_before):
+    assert report.ok, report.summary()
+    completed = exp.fetch_trials_by_status("completed")
+    assert len(completed) >= max_trials
+    # Zero duplicated trials / zero lost observations, asserted directly on
+    # top of the auditor's word.
+    points = {t.hash_params for t in exp.fetch_trials()}
+    assert len(points) == len(exp.fetch_trials())
+    assert all(t.objective is not None for t in completed)
+    # The schedule actually fired, and the retry path absorbed it.
+    assert schedule.total_injected > 0, "fault schedule never fired"
+    assert (
+        registry.counter_value("storage.retries") > retries_before
+    ), "faults fired but nothing retried — the policy is not wired in"
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_chaos_tiny_seeded_schedule(tmp_path, backend, telemetry_enabled):
+    """Tier-1 chaos: a tiny pinned-plan schedule (one fault per round
+    class) on every backend; the experiment completes and audits clean."""
+    registry = telemetry_enabled
+    retries_before = registry.counter_value("storage.retries")
+    schedule = FaultSchedule(
+        seed=7, plan=dict(TINY_PLAN), rates=TINY_RATES, latency=0.005,
+        max_faults=10,
+    )
+    storage, cleanup, proxy = _make_faulty_storage(backend, tmp_path, schedule)
+    try:
+        exp, report = drive_chaos_experiment(
+            storage, max_trials=9, seed=1, proxy=proxy,
+            drop_every=4 if proxy is not None else 0,
+        )
+        _assert_chaos_outcome(exp, report, schedule, 9, registry, retries_before)
+        # Every round class fired at least once (kill may defer to the
+        # next batch op, but a produce round always offers one).
+        for kind in FAULT_KINDS:
+            assert schedule.injected[kind] >= 1, (
+                f"fault class {kind!r} never fired: {schedule.injected}"
+            )
+        if proxy is not None:
+            # The connection drops exercised the driver's real reconnects.
+            assert storage.db.reconnects >= 1
+    finally:
+        if cleanup is not None:
+            cleanup()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_chaos_long_schedule_soak(tmp_path, backend, telemetry_enabled):
+    """The soak: higher fault rates, more trials, no pinned plan — pure
+    seeded pressure.  Excluded from tier-1 (-m 'not slow')."""
+    registry = telemetry_enabled
+    retries_before = registry.counter_value("storage.retries")
+    schedule = FaultSchedule(
+        seed=23,
+        rates={"error": 0.08, "reply_lost": 0.05, "latency": 0.08, "kill": 0.04},
+        latency=0.01,
+        max_faults=60,
+    )
+    storage, cleanup, proxy = _make_faulty_storage(backend, tmp_path, schedule)
+    try:
+        exp, report = drive_chaos_experiment(
+            storage, max_trials=30, seed=2, proxy=proxy,
+            drop_every=5 if proxy is not None else 0,
+        )
+        _assert_chaos_outcome(exp, report, schedule, 30, registry, retries_before)
+    finally:
+        if cleanup is not None:
+            cleanup()

@@ -768,3 +768,40 @@ def test_db_copy_refuses_missing_source(tmp_path, capsys):
     assert rc == 1
     assert "does not exist" in capsys.readouterr().err
     assert not (tmp_path / "typo.pkl").exists()
+
+
+def test_audit_clean_experiment(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["audit", "-n", "cmd-exp", *db])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "audit: OK" in out
+    assert "4 completed" in out
+
+
+def test_audit_reports_violations_and_exits_nonzero(populated, capsys):
+    tmp_path, db = populated
+    # Corrupt the store the way a dead worker would leave it: a reserved
+    # trial whose heartbeat went stale far past the sweep threshold.
+    storage = create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+    exp = storage.fetch_experiments({"name": "cmd-exp"})[0]
+    from orion_tpu.core.trial import Trial
+
+    storage.register_trial(
+        Trial(
+            experiment=exp["_id"], status="reserved", params={"/x": 3.25},
+            start_time=1.0, heartbeat=1.0,
+        )
+    )
+    rc = cli_main(["audit", "-n", "cmd-exp", *db])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "orphaned-reservation" in out
+
+
+def test_audit_all_experiments(populated, capsys):
+    tmp_path, db = populated
+    rc = cli_main(["audit", "--all", *db])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "audit: OK" in out

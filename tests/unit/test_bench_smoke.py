@@ -119,3 +119,30 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path):
         for c in commits
         for w in windows
     ), "storage.commit no longer overlaps the device.dispatch window"
+
+
+def test_bench_chaos_smoke_reports_retries_and_audits_clean():
+    """``bench.py --chaos``: the seeded fault schedules fire, the retry
+    policy absorbs them (storage.retries > 0 on the faulted sqlite run,
+    reconnects > 0 through the fault proxy), and the invariant auditor
+    reports zero violations — bench.py hard-asserts all of it; this test
+    pins the emitted schema on top."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--chaos"],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["metric"] == "chaos smoke"
+    sqlite = payload["backends"]["sqlite"]
+    assert sqlite["storage_retries_per_round"] > 0
+    assert sqlite["audit_violations"] == 0
+    assert sum(sqlite["faults_injected"].values()) > 0
+    network = payload["backends"]["network"]
+    assert network["reconnects_per_round"] > 0
+    assert network["audit_violations"] == 0

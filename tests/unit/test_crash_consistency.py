@@ -21,6 +21,28 @@ import pytest
 PAYLOAD = "x" * 256
 
 
+@pytest.fixture
+def proxied_netdb():
+    """A NetworkDB talking to a live DBServer through a FaultProxy, so
+    server-death-mid-operation scenarios are deterministic (the proxy
+    plays the restarting server's connection behavior byte-for-byte)."""
+    from orion_tpu.storage.faults import FaultProxy
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    proxy = FaultProxy(host, port)
+    phost, pport = proxy.serve_background()
+    db = NetworkDB(host=phost, port=pport, timeout=10.0)
+    try:
+        yield db, server, proxy
+    finally:
+        db._close()
+        proxy.stop()
+        server.shutdown()
+        server.server_close()
+
+
 def _hammer_writes(backend, path, barrier, seq_base):
     db = _open(backend, path)
     barrier.wait()
@@ -158,3 +180,137 @@ def test_overlapped_commit_failure_keeps_suggest_batch_consistent(
     assert exp.algorithm.n_observed == len(trials)
     producer.update()
     assert exp.algorithm.n_observed == len(trials)
+
+
+# --- netdb server-restart-mid-batch contracts (driven through FaultProxy) ----
+#
+# The wire contracts the batched write path documents (netdb.py apply_batch/
+# pipeline docstrings, docs/robustness.md idempotency table), pinned against
+# a REAL server with the proxy playing the dying connection:
+#
+# - never-applied: the connection dies before the request reaches the server
+#   (send-phase EPIPE on a restarting server).  Nothing applied; a resend is
+#   safe and applies exactly once (at-most-once, then converging retry).
+# - reply-lost: the server applied the batch but its reply never arrived.
+#   The client MUST surface applied-or-not-unknowable (maybe_applied), and a
+#   re-send converges through the unique index (at-least-once + dedup).
+# - mid-pipeline cut: only a prefix of the pipelined request lines survives;
+#   the server's readline guard drops the torn line, so exactly the prefix
+#   applies.
+
+
+def _batch_insert_ops(n, start=0):
+    return [
+        ("write", ["docs", {"_id": start + i, "payload": PAYLOAD}], {})
+        for i in range(n)
+    ]
+
+
+def test_netdb_apply_batch_reply_lost_converges(proxied_netdb):
+    from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
+
+    db, server, proxy = proxied_netdb
+    db.ensure_index("docs", ["_id"], unique=False)  # warm the connection
+    proxy.fail_next("drop_reply")
+    with pytest.raises(DatabaseError) as err:
+        db.apply_batch(_batch_insert_ops(4))
+    # Applied server-side, reply lost: the ambiguity MUST be marked.
+    assert err.value.maybe_applied
+    assert len(server.db.read("docs")) == 4  # at-least-once: it landed
+    # The converging retry: a resend reports every slot as the duplicate it
+    # now is — nothing double-applies.
+    outcomes = db.apply_batch(_batch_insert_ops(4))
+    assert all(isinstance(o, DuplicateKeyError) for o in outcomes)
+    assert len(server.db.read("docs")) == 4
+    assert db.reconnects >= 1  # the real reconnect path ran, not a mock
+
+
+def test_netdb_apply_batch_never_applied_resend_is_safe(proxied_netdb):
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    db, server, proxy = proxied_netdb
+    db.ensure_index("docs", ["_id"], unique=False)
+    proxy.fail_next("drop_request")
+    # The connection dies before the request reaches the server.  From the
+    # client's seat this is indistinguishable from a reply loss (the bytes
+    # left its socket), so it MUST report the same ambiguity...
+    with pytest.raises(DatabaseError) as err:
+        db.apply_batch(_batch_insert_ops(4))
+    assert err.value.maybe_applied
+    # ...but the at-most-once half of the contract holds: NOTHING was
+    # applied, and the resend therefore applies exactly once, cleanly.
+    assert server.db.read("docs") == []
+    outcomes = db.apply_batch(_batch_insert_ops(4))
+    assert not any(isinstance(o, Exception) for o in outcomes)
+    assert len(server.db.read("docs")) == 4
+    assert db.reconnects >= 1
+
+
+def test_netdb_restart_while_idle_is_transparent(proxied_netdb):
+    """A server restart while the connection sits idle: the driver's
+    idle-probe pings the dead socket and reconnects BEFORE the mutation
+    rides it — the batch succeeds with no ambiguity at all."""
+    db, server, proxy = proxied_netdb
+    db.idle_probe = 0.05
+    db.ensure_index("docs", ["_id"], unique=False)
+    proxy.drop_all()  # the "restart": every live connection dies
+    time.sleep(0.1)  # sit idle past the probe threshold
+    outcomes = db.apply_batch(_batch_insert_ops(4))
+    assert not any(isinstance(o, Exception) for o in outcomes)
+    assert len(server.db.read("docs")) == 4
+    assert db.reconnects >= 1
+
+
+def test_netdb_pipeline_reply_lost_converges(proxied_netdb):
+    from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
+
+    db, server, proxy = proxied_netdb
+    db.ensure_index("docs", ["_id"], unique=False)
+    proxy.fail_next("drop_reply")
+    with pytest.raises(DatabaseError) as err:
+        db.pipeline(_batch_insert_ops(3))
+    assert err.value.maybe_applied
+    assert len(server.db.read("docs")) == 3
+    outcomes = db.pipeline(_batch_insert_ops(3))
+    assert all(isinstance(o, DuplicateKeyError) for o in outcomes)
+    assert len(server.db.read("docs")) == 3
+
+
+def test_netdb_pipeline_cut_mid_batch_applies_exact_prefix(proxied_netdb):
+    from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
+
+    db, server, proxy = proxied_netdb
+    db.ensure_index("docs", ["_id"], unique=False)
+    proxy.fail_next("cut_first_line")
+    with pytest.raises(DatabaseError) as err:
+        db.pipeline(_batch_insert_ops(3))
+    assert err.value.maybe_applied
+    # Exactly the first request line survived the "restart"; the torn
+    # remainder was dropped by the server's readline guard.
+    docs = server.db.read("docs")
+    assert [d["_id"] for d in docs] == [0]
+    # Recovery: resend the whole batch — slot 0 dedups, the rest applies.
+    outcomes = db.pipeline(_batch_insert_ops(3))
+    assert isinstance(outcomes[0], DuplicateKeyError)
+    assert not any(isinstance(o, Exception) for o in outcomes[1:])
+    assert len(server.db.read("docs")) == 3
+
+
+def test_netdb_storage_layer_converges_through_reply_lost(proxied_netdb):
+    """Full stack over the proxy: DocumentStorage.register_trials with the
+    unified retry policy rides out an applied-and-reply-lost batch without
+    duplicating or losing a trial."""
+    from orion_tpu.core.trial import Trial
+    from orion_tpu.storage.base import DocumentStorage
+
+    db, server, proxy = proxied_netdb
+    storage = DocumentStorage(
+        db, retry={"max_attempts": 4, "base_delay": 0.01, "jitter": 0.0}
+    )
+    trials = [Trial(experiment="e", params={"/x": i / 10}) for i in range(4)]
+    proxy.fail_next("drop_reply")
+    outcomes = storage.register_trials(trials)
+    assert len(outcomes) == 4
+    stored = storage.fetch_trials(uid="e")
+    assert len(stored) == 4
+    assert len({t.id for t in stored}) == 4  # exactly once each
