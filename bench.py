@@ -639,6 +639,31 @@ def main_chaos(rounds=6, q=8, seed=11):
     print(json.dumps(payload))
 
 
+def lint_preflight():
+    """Self-lint the tree before timing anything: bench numbers taken on a
+    contract-violating tree (a host sync inside the fused step, a storage
+    op off the retry policy) are not numbers worth recording.  Hard-fails
+    with the full findings list; returns the violation count (0 when the
+    gate passes) for the emitted payload."""
+    import os
+
+    import orion_tpu
+    from orion_tpu.analysis import format_human, run_lint
+
+    paths = [
+        os.path.dirname(os.path.abspath(orion_tpu.__file__)),
+        os.path.abspath(__file__),
+    ]
+    diagnostics = run_lint(paths)
+    if diagnostics:
+        # Not an assert: the gate must hold under `python -O` too.
+        raise SystemExit(
+            "lint preflight failed — fix the tree before benching:\n"
+            + format_human(diagnostics)
+        )
+    return len(diagnostics)
+
+
 def main_smoke(trace_out="bench_trace.json"):
     """Tiny-n schema smoke: the same JSON line shape in seconds instead of
     minutes — no regret parity, no sklearn anchor, no device
@@ -647,6 +672,7 @@ def main_smoke(trace_out="bench_trace.json"):
     span names, so bench schema drift (a renamed stage, a dropped counter,
     a broken trace export) is caught by the unit suite instead of the next
     full bench run."""
+    lint_violations = lint_preflight()
     q = 32
     algo = _make_algo(seed=SEED + 2, n_candidates=512, fit_steps=8)
     breakdown = bench_breakdown(rounds=1, q=q, algo=algo, n_hist=20)
@@ -676,6 +702,7 @@ def main_smoke(trace_out="bench_trace.json"):
         smoke=True,
     )
     payload["trace_file"] = trace_file
+    payload["lint_violations"] = lint_violations
     print(json.dumps(payload))
 
 

@@ -115,7 +115,9 @@ def acquire(key, state, candidates, q, kind="matern52", acq="thompson", best=Non
         return jnp.argmax(draws, axis=1)
     if acq == "ei":
         if best is None:
-            best = jnp.min(jnp.where(state.mask > 0, (state.y - state.y_mean) / state.y_std, jnp.inf))
+            best = jnp.min(
+                jnp.where(state.mask > 0, (state.y - state.y_mean) / state.y_std, jnp.inf)
+            )
         return select_q(expected_improvement(mean, std, best), q)
     if acq == "ucb":
         return select_q(upper_confidence_bound(mean, std, beta=beta), q)

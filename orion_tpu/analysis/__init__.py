@@ -1,0 +1,39 @@
+"""Static analysis for orion-tpu's own invariants (``orion-tpu lint``).
+
+Four rule families over the codebase's AST, each pinning a convention the
+runtime cannot check for itself:
+
+- ``JIT*``  — retrace hygiene inside jit-compiled functions and at their
+  call sites (``jit_rules``);
+- ``STO*``  — storage protocol ops ride the unified retry policy with an
+  explicit applied-or-not mode, and wire errors carry ``maybe_applied``
+  (``storage_rules``);
+- ``TEL*``  — telemetry stays allocation-free when disabled and cheap when
+  enabled (``telemetry_rules``);
+- ``LCK*``  — the static lock graph stays acyclic and shared attributes
+  stay behind their lock (``lock_rules``).
+
+``run_lint(paths)`` is the whole API; the tier-1 self-lint test and the
+bench ``--smoke`` preflight both call it directly.  Rule catalog and
+suppression syntax: ``docs/static_analysis.md``.
+"""
+
+from orion_tpu.analysis.engine import (
+    Diagnostic,
+    Rule,
+    default_rules,
+    format_human,
+    format_json,
+    rule_catalog,
+    run_lint,
+)
+
+__all__ = [
+    "Diagnostic",
+    "Rule",
+    "default_rules",
+    "format_human",
+    "format_json",
+    "rule_catalog",
+    "run_lint",
+]

@@ -1,0 +1,493 @@
+"""JIT/retrace hygiene rules (``JIT001``–``JIT003``).
+
+The fused suggest step's zero-retrace contract (PR 4) dies quietly: one
+``.item()`` inside a jitted function turns every round into a blocking
+device sync, one Python ``if`` on a traced value becomes a
+ConcretizationTypeError only on the first call with a fresh shape, and one
+Python scalar threaded positionally into a non-static slot forks the jit
+cache signature the prewarmer so carefully pins.  These rules make the
+contract static: they find every function compiled by ``jax.jit`` (as a
+decorator, through ``partial(jax.jit, ...)``, by wrapping — ``g =
+jax.jit(f)`` — or by name in :data:`FUSED_STEP_REGISTRY`), compute which
+parameters are traced (everything not named by ``static_argnums`` /
+``static_argnames``), and check the bodies and the call sites.
+"""
+
+import ast
+
+from orion_tpu.analysis.engine import (
+    Diagnostic,
+    Rule,
+    arg_names,
+    dotted_name,
+    enclosing_class,
+    enclosing_function,
+)
+
+#: Functions treated as jit-compiled even when the decorator is indirect
+#: (registered fused steps whose compilation happens behind a helper).
+#: Extend this set when a new fused step is added outside the
+#: decorator/wrapper forms the detector recognizes.
+FUSED_STEP_REGISTRY = frozenset({"_suggest_step"})
+
+#: Host-side numpy module aliases — calling these on traced values forces a
+#: transfer (or a tracer leak) inside the compiled function.
+_NUMPY_ALIASES = frozenset({"np", "numpy"})
+
+#: Attribute calls that synchronize with the device.
+_HOST_SYNC_ATTRS = frozenset({"item", "block_until_ready", "tolist", "numpy"})
+
+#: Builtins that force a concrete (host) value out of a tracer.
+_CONCRETIZING_BUILTINS = frozenset({"float", "int", "bool"})
+
+#: Array attributes that are CONCRETE under tracing — reading them neither
+#: syncs nor retraces, so ``x.shape[0]`` branch/float is trace-safe.
+_STATIC_METADATA_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "weak_type"})
+
+
+def _static_info_from_call(call):
+    """(static_argnums, static_argnames) extracted from a jax.jit /
+    partial(jax.jit, ...) call's keywords; unknown/dynamic values are
+    treated as empty (conservative: more params count as traced)."""
+    nums, names = set(), set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            nums |= set(_const_ints(kw.value))
+        elif kw.arg == "static_argnames":
+            names |= set(_const_strs(kw.value))
+    return nums, names
+
+
+def _const_ints(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def _const_strs(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def _is_jax_jit(node):
+    """True for ``jax.jit`` / ``jit`` references."""
+    name = dotted_name(node)
+    return name in ("jax.jit", "jit")
+
+
+def _jit_decoration(fn):
+    """(is_jit, static_argnums, static_argnames) from a function's
+    decorator list.  Recognizes ``@jax.jit``, ``@partial(jax.jit, ...)``
+    and ``@functools.partial(jax.jit, ...)``."""
+    for dec in fn.decorator_list:
+        if _is_jax_jit(dec):
+            return True, set(), set()
+        if isinstance(dec, ast.Call):
+            if _is_jax_jit(dec.func):
+                return (True,) + _static_info_from_call(dec)
+            fname = dotted_name(dec.func)
+            if fname in ("partial", "functools.partial") and dec.args:
+                if _is_jax_jit(dec.args[0]):
+                    return (True,) + _static_info_from_call(dec)
+    return False, set(), set()
+
+
+class JitFunction:
+    """One function known to be jit-compiled, with its static params.
+
+    ``call_names`` are the names a HOST call site reaches the compiled
+    object by: the def's own name for decorated functions, the binding
+    target for the wrapper form (`fast = jax.jit(slow)` is called as
+    ``fast`` — a direct ``slow(...)`` call runs eagerly and never touches
+    the jit cache)."""
+
+    __slots__ = ("node", "path", "static_nums", "static_names", "call_names")
+
+    def __init__(self, node, path, static_nums, static_names, call_names=None):
+        self.node = node
+        self.path = path
+        self.static_nums = set(static_nums)
+        self.static_names = set(static_names)
+        self.call_names = set(call_names) if call_names is not None else {node.name}
+
+    def positional_params(self):
+        ordered, _extra = arg_names(self.node)
+        return ordered
+
+    def traced_params(self):
+        """Parameter names the tracer sees as abstract values."""
+        ordered, extra = arg_names(self.node)
+        static = set(self.static_names)
+        for index in self.static_nums:
+            if 0 <= index < len(ordered):
+                static.add(ordered[index])
+        return {name for name in ordered + extra if name not in static}
+
+    def is_static_position(self, index):
+        ordered = self.positional_params()
+        if index in self.static_nums:
+            return True
+        return 0 <= index < len(ordered) and ordered[index] in self.static_names
+
+    def is_method(self):
+        return enclosing_class(self.node) is not None
+
+
+def collect_jit_functions(module):
+    """Every jit-compiled function defined in ``module``.
+
+    Three forms: decorated defs, wrapper assignments (``g = jax.jit(f,
+    ...)`` marks ``f``), and :data:`FUSED_STEP_REGISTRY` names.  The result
+    is cached on the Module (JIT001/002 call this per check and JIT003 per
+    project) and dies with it — same per-run discipline as
+    ``lock_rules._project_index``."""
+    cached = getattr(module, "lint_jit_functions", None)
+    if cached is None:
+        cached = module.lint_jit_functions = _collect_jit_functions(module)
+    return cached
+
+
+def _collect_jit_functions(module):
+    # Every def, NOT collapsed by name: a jitted def sharing its name with
+    # a plain def elsewhere in the module (method vs module function, or
+    # shadowing) must still have its body checked, so the result is keyed
+    # by node identity.
+    defs = [
+        node
+        for node in ast.walk(module.tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    out = {}
+    for node in defs:
+        is_jit, nums, names = _jit_decoration(node)
+        if not is_jit and node.name in FUSED_STEP_REGISTRY:
+            is_jit = True
+        if is_jit:
+            out[id(node)] = JitFunction(node, module.path, nums, names)
+    for node in ast.walk(module.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call) and _is_jax_jit(value.func)):
+            continue
+        if not value.args:
+            continue
+        # The wrapper form `g = jax.jit(f)` references f by bare name, so
+        # it can only mean a module-level def; with several, Python's
+        # shadowing makes the LAST one the live binding.  The method form
+        # `self._g = jax.jit(self._impl)` resolves within the enclosing
+        # class instead.
+        target_node = value.args[0]
+        if isinstance(target_node, ast.Name):
+            candidates = [
+                d
+                for d in defs
+                if d.name == target_node.id
+                and getattr(d, "lint_parent", None) is module.tree
+            ]
+        elif (
+            isinstance(target_node, ast.Attribute)
+            and isinstance(target_node.value, ast.Name)
+            and target_node.value.id == "self"
+        ):
+            cls = enclosing_class(node)
+            candidates = [
+                d
+                for d in defs
+                if d.name == target_node.attr
+                and cls is not None
+                and enclosing_class(d) is cls
+            ]
+        else:
+            continue
+        if not candidates:
+            continue
+        wrapped = candidates[-1]
+        nums, names = _static_info_from_call(value)
+        # Host call sites reach the wrapper through its BINDING name(s);
+        # self-attribute bindings contribute none (a bound-method wrap
+        # shifts static positions — the body is still checked via
+        # JIT001/002, only JIT003 call-site matching skips them).
+        bind_targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        bindings = {t.id for t in bind_targets if isinstance(t, ast.Name)}
+        existing = out.get(id(wrapped))
+        if existing is not None:
+            # Wrapped twice (donating/copying twins): statics must agree on
+            # the conservative union of traced params -> intersect statics.
+            existing.static_nums &= nums
+            existing.static_names &= names
+            existing.call_names |= bindings
+        else:
+            out[id(wrapped)] = JitFunction(
+                wrapped, module.path, nums, names, call_names=bindings
+            )
+    return out
+
+
+def _imported_module_aliases(module):
+    """Dotted paths bound to imported MODULES: ``import x.y`` -> "x.y"
+    (reached at call sites as ``x.y.fn``), ``import x.y as z`` -> "z".
+    ``from``-imports are left out: they bind functions/classes/instances
+    as often as submodules, and guessing wrong would re-open the
+    method-vs-module misattribution this distinction exists to close."""
+    cached = getattr(module, "lint_module_aliases", None)
+    if cached is None:
+        cached = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    cached.add(alias.asname or alias.name)
+        module.lint_module_aliases = cached
+    return cached
+
+
+def _names_in(node, skip_is_none=False):
+    """All Name identifiers read inside ``node``.  With ``skip_is_none``,
+    reads that sit inside an ``x is None`` / ``x is not None`` compare are
+    excluded (that test never inspects a traced value) — but only those
+    READS, not the name wholesale: in ``x is None or x > 0`` the second
+    read still concretizes ``x`` and must count.  Reads that only touch
+    static array metadata (``x.shape``/``x.ndim``/``x.dtype``) are
+    likewise exempt: those are concrete under tracing."""
+    exempt_reads = set()
+    if skip_is_none:
+        for cmp_node in ast.walk(node):
+            if not isinstance(cmp_node, ast.Compare):
+                continue
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in cmp_node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in cmp_node.comparators
+            ):
+                exempt_reads |= {
+                    id(n) for n in ast.walk(cmp_node) if isinstance(n, ast.Name)
+                }
+    names = set()
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Name)
+            and isinstance(sub.ctx, ast.Load)
+            and id(sub) not in exempt_reads
+        ):
+            parent = getattr(sub, "lint_parent", None)
+            if (
+                isinstance(parent, ast.Attribute)
+                and parent.value is sub
+                and parent.attr in _STATIC_METADATA_ATTRS
+            ):
+                continue
+            names.add(sub.id)
+    return names
+
+
+class HostSyncInJit(Rule):
+    id = "JIT001"
+    name = "host-sync-in-jit"
+    description = (
+        "No host synchronization inside a jit-compiled function: .item() / "
+        ".tolist() / .block_until_ready(), float()/int()/bool() on traced "
+        "parameters, or numpy (np.*) calls over traced values."
+    )
+
+    def check(self, module):
+        for jit_fn in collect_jit_functions(module).values():
+            traced = jit_fn.traced_params()
+            for node in ast.walk(jit_fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in _HOST_SYNC_ATTRS
+                ):
+                    yield Diagnostic(
+                        module.path,
+                        node.lineno,
+                        node.col_offset,
+                        self.id,
+                        f".{func.attr}() inside jit function "
+                        f"'{jit_fn.node.name}' forces a host sync; keep the "
+                        "value on device or move the read outside the jit",
+                    )
+                    continue
+                fname = dotted_name(func)
+                if fname in _CONCRETIZING_BUILTINS and node.args:
+                    used = _names_in(node.args[0]) & traced
+                    if used:
+                        yield Diagnostic(
+                            module.path,
+                            node.lineno,
+                            node.col_offset,
+                            self.id,
+                            f"{fname}() concretizes traced value "
+                            f"{sorted(used)[0]!r} inside jit function "
+                            f"'{jit_fn.node.name}'; use jnp ops or make the "
+                            "argument static",
+                        )
+                    continue
+                if (
+                    fname
+                    and "." in fname
+                    and fname.split(".", 1)[0] in _NUMPY_ALIASES
+                ):
+                    used = set()
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        used |= _names_in(arg) & traced
+                    if used:
+                        yield Diagnostic(
+                            module.path,
+                            node.lineno,
+                            node.col_offset,
+                            self.id,
+                            f"numpy call {fname}() over traced value "
+                            f"{sorted(used)[0]!r} inside jit function "
+                            f"'{jit_fn.node.name}'; use jax.numpy instead",
+                        )
+
+
+class BranchOnTraced(Rule):
+    id = "JIT002"
+    name = "branch-on-traced"
+    description = (
+        "No Python control flow on traced values inside a jit-compiled "
+        "function: if/while/assert on a traced parameter traces only one "
+        "side (or raises ConcretizationTypeError); use lax.cond/jnp.where."
+    )
+
+    def check(self, module):
+        for jit_fn in collect_jit_functions(module).values():
+            traced = jit_fn.traced_params()
+            for node in ast.walk(jit_fn.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                else:
+                    continue
+                used = _names_in(test, skip_is_none=True) & traced
+                if used:
+                    kind = type(node).__name__.lower()
+                    yield Diagnostic(
+                        module.path,
+                        node.lineno,
+                        node.col_offset,
+                        self.id,
+                        f"python {kind} on traced value {sorted(used)[0]!r} "
+                        f"inside jit function '{jit_fn.node.name}'; use "
+                        "jnp.where/lax.cond (or declare the argument static)",
+                    )
+
+
+class UnpinnedScalarArg(Rule):
+    id = "JIT003"
+    name = "unpinned-scalar-arg"
+    description = (
+        "No bare Python numeric literals threaded positionally into a "
+        "non-static slot of a jit-compiled function from host code: the "
+        "weak-typed scalar forks the jit cache signature the prewarmer "
+        "pins (pass an array with an explicit dtype, or make the slot "
+        "static)."
+    )
+
+    def begin(self, modules):
+        # name -> list of JitFunction across the project: call sites usually
+        # import the function by name, so the registry is keyed on it.  A
+        # slot is flagged only if it is non-static in EVERY registration of
+        # that name (conservative under collisions).
+        self._registry = {}
+        self._jit_spans = {}  # path -> list of jit function nodes
+        for module in modules:
+            fns = collect_jit_functions(module)
+            for jit_fn in fns.values():
+                for call_name in jit_fn.call_names:
+                    self._registry.setdefault(call_name, []).append(jit_fn)
+            self._jit_spans[module.path] = [f.node for f in fns.values()]
+
+    def _inside_jit(self, module, node):
+        """Literal scalars in jit-to-jit calls are constant-folded into the
+        trace — only host-side call sites can fork the cache signature."""
+        jit_nodes = set(map(id, self._jit_spans.get(module.path, ())))
+        fn = enclosing_function(node)
+        while fn is not None:
+            if id(fn) in jit_nodes:
+                return True
+            fn = enclosing_function(fn)
+        return False
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name):
+                name = func.id
+                # A bare-name call can only reach a module-level jit
+                # function; bound methods arrive as Attribute calls with
+                # the self slot implicit, shifting positions by one.
+                offset = 0
+                wants_method = False
+            elif isinstance(func, ast.Attribute):
+                name = func.attr
+                # `mod.fn(...)` / `pkg.mod.fn(...)` through an imported
+                # module path is a module-level call (no self slot); any
+                # other base is assumed to be a bound method.
+                dotted = dotted_name(func)
+                if (
+                    dotted is not None
+                    and dotted.rsplit(".", 1)[0]
+                    in _imported_module_aliases(module)
+                ):
+                    offset = 0
+                    wants_method = False
+                else:
+                    offset = 1
+                    wants_method = True
+            else:
+                continue
+            candidates = self._registry.get(name)
+            if not candidates or self._inside_jit(module, node):
+                continue
+            candidates = [
+                fn for fn in candidates if fn.is_method() == wants_method
+            ]
+            if not candidates:
+                continue
+            for index, arg in enumerate(node.args):
+                position = index + offset
+                if not (
+                    isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, (int, float))
+                    and not isinstance(arg.value, bool)
+                ):
+                    continue
+                if any(fn.is_static_position(position) for fn in candidates):
+                    continue
+                if all(
+                    position >= len(fn.positional_params()) for fn in candidates
+                ):
+                    continue
+                yield Diagnostic(
+                    module.path,
+                    arg.lineno,
+                    arg.col_offset,
+                    self.id,
+                    f"python scalar {arg.value!r} passed positionally into "
+                    f"non-static slot {position} of jit function '{name}'; "
+                    "wrap in jnp.asarray(..., dtype=...) or pin it via "
+                    "static_argnums/static_argnames",
+                )
+
+
+JIT_RULES = (HostSyncInJit, BranchOnTraced, UnpinnedScalarArg)

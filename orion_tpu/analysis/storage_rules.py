@@ -1,0 +1,222 @@
+"""Storage retry/trace coverage rules (``STO001``–``STO003``).
+
+PR 5 unified failure semantics on one invariant: every storage protocol op
+rides the shared :class:`~orion_tpu.storage.retry.RetryPolicy` through the
+``_traced``/``_retrying`` decorators, with a declared applied-or-not mode —
+and every ambiguous wire loss carries ``maybe_applied`` so non-converging
+ops can refuse a blind re-send.  A new protocol op that skips the decorator
+silently reverts to pre-policy crash-on-transient behavior; a new
+``DatabaseError`` raised after bytes may have hit the wire without the
+flag silently turns CAS retries unsafe.  These rules pin both.
+"""
+
+import ast
+
+from orion_tpu.analysis.engine import Diagnostic, Rule, dotted_name
+
+#: A class participates in the storage protocol when it, or any base by
+#: name, carries one of these names.
+_STORAGE_BASES = ("BaseStorage", "DocumentStorage")
+
+#: Decorators that apply the unified retry policy.
+_RETRY_DECORATORS = ("_traced", "_retrying")
+
+#: The explicit-mode keyword each decorator takes.
+_MODE_KEYWORDS = {"_traced": "retry", "_retrying": "mode"}
+
+#: Wire-send markers: a function containing one of these calls may have put
+#: bytes on the wire before any later failure.
+_SEND_ATTRS = frozenset({"sendall", "_exchange"})
+
+
+def _is_storage_class(node):
+    if node.name in _STORAGE_BASES:
+        return True
+    for base in node.bases:
+        name = dotted_name(base) or ""
+        if name.split(".")[-1] in _STORAGE_BASES:
+            return True
+    return False
+
+
+def _touches_db(fn):
+    """True when the method body reads ``self._db`` (the raw backend)."""
+    for node in ast.walk(fn):
+        if dotted_name(node) == "self._db" and isinstance(node, ast.Attribute):
+            return True
+        # _db_batch / _db_batch_capable route to the backend too.
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name.startswith("self._db_batch"):
+                return True
+    return False
+
+
+def _retry_decorator(fn):
+    """The ``_traced``/``_retrying`` decorator Call on ``fn``, or None."""
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call):
+            name = (dotted_name(dec.func) or "").split(".")[-1]
+            if name in _RETRY_DECORATORS:
+                return name, dec
+        else:
+            name = (dotted_name(dec) or "").split(".")[-1]
+            if name in _RETRY_DECORATORS:
+                return name, None
+    return None, None
+
+
+def _has_property_decorator(fn):
+    return any((dotted_name(d) or "") == "property" for d in fn.decorator_list)
+
+
+class UncoveredStorageOp(Rule):
+    id = "STO001"
+    name = "uncovered-storage-op"
+    description = (
+        "Every public method of a BaseStorage/DocumentStorage subclass that "
+        "touches self._db must be wrapped in _traced(...)/_retrying(...) so "
+        "it rides the unified retry policy (and, for hot ops, the telemetry "
+        "span/histogram channel)."
+    )
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef) or not _is_storage_class(node):
+                continue
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                # Private methods (and thereby every dunder lifecycle hook)
+                # are out of scope: the rule covers the public protocol.
+                if item.name.startswith("_"):
+                    continue
+                if _has_property_decorator(item):
+                    continue
+                if not _touches_db(item):
+                    continue
+                name, _call = _retry_decorator(item)
+                if name is None:
+                    yield Diagnostic(
+                        module.path,
+                        item.lineno,
+                        item.col_offset,
+                        self.id,
+                        f"storage op '{node.name}.{item.name}' touches "
+                        "self._db without @_traced/@_retrying — it would "
+                        "crash on the first transient backend failure "
+                        "instead of riding the unified retry policy",
+                    )
+
+
+class ImplicitRetryMode(Rule):
+    id = "STO002"
+    name = "implicit-retry-mode"
+    description = (
+        "_traced/_retrying decorators must declare their applied-or-not "
+        "mode explicitly (retry=MODE_ALWAYS/MODE_UNAPPLIED/None for "
+        "_traced, mode=... for _retrying): whether an op converges under "
+        "re-application is a per-op correctness decision, not a default."
+    )
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            name, call = _retry_decorator(node)
+            if name is None:
+                continue
+            keyword = _MODE_KEYWORDS[name]
+            if call is None or not any(kw.arg == keyword for kw in call.keywords):
+                yield Diagnostic(
+                    module.path,
+                    node.lineno,
+                    node.col_offset,
+                    self.id,
+                    f"@{name} on '{node.name}' relies on the default retry "
+                    f"mode; declare {keyword}=MODE_ALWAYS / MODE_UNAPPLIED "
+                    "(or None to opt out) so the convergence contract is "
+                    "visible at the op",
+                )
+
+
+class AmbiguousWireError(Rule):
+    id = "STO003"
+    name = "ambiguous-wire-error"
+    description = (
+        "In a function that sends on the wire (calls .sendall()/"
+        "._exchange()), every DatabaseError raised must carry an explicit "
+        "maybe_applied decision — raise a variable whose .maybe_applied "
+        "was assigned, or suppress with the reason why nothing was sent."
+    )
+
+    def _sends(self, fn):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in _SEND_ATTRS:
+                    return True
+        return False
+
+    def check(self, module):
+        for fn in [
+            n
+            for n in ast.walk(module.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]:
+            if not self._sends(fn):
+                continue
+            # Names whose .maybe_applied is assigned somewhere in this fn.
+            marked = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if (
+                            isinstance(target, ast.Attribute)
+                            and target.attr == "maybe_applied"
+                            and isinstance(target.value, ast.Name)
+                        ):
+                            marked.add(target.value.id)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                if isinstance(exc, ast.Call):
+                    name = (dotted_name(exc.func) or "").split(".")[-1]
+                    if name == "DatabaseError":
+                        yield Diagnostic(
+                            module.path,
+                            node.lineno,
+                            node.col_offset,
+                            self.id,
+                            "DatabaseError raised inline in a wire-send "
+                            "function without a maybe_applied decision; "
+                            "assign it to a variable and set "
+                            ".maybe_applied before raising",
+                        )
+                elif isinstance(exc, ast.Name) and exc.id not in marked:
+                    # `raise exc` re-raising the caught error propagates its
+                    # own maybe_applied — but only if the caught name wasn't
+                    # rebound to a fresh DatabaseError without the flag.
+                    if self._binds_database_error(fn, exc.id):
+                        yield Diagnostic(
+                            module.path,
+                            node.lineno,
+                            node.col_offset,
+                            self.id,
+                            f"DatabaseError variable {exc.id!r} raised in a "
+                            "wire-send function without .maybe_applied ever "
+                            "being set on it",
+                        )
+
+    def _binds_database_error(self, fn, name):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                callee = (dotted_name(node.value.func) or "").split(".")[-1]
+                if callee == "DatabaseError" and any(
+                    isinstance(t, ast.Name) and t.id == name for t in node.targets
+                ):
+                    return True
+        return False
+
+
+STORAGE_RULES = (UncoveredStorageOp, ImplicitRetryMode, AmbiguousWireError)

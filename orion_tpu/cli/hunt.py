@@ -22,7 +22,8 @@ def add_subparser(subparsers):
         default=None,
         help="trials this worker executes before exiting (default: unlimited)",
     )
-    group.add_argument("--pool-size", type=int, default=None, help="suggestions per producer round")
+    group.add_argument("--pool-size", type=int, default=None,
+                       help="suggestions per producer round")
     group.add_argument("--working-dir", default=None, help="permanent trial working directory")
     group.add_argument("--max-broken", type=int, default=None, help="broken-trial budget")
     group.add_argument(

@@ -60,7 +60,8 @@ def format_info(experiment):
         for key, value in sorted(stats.get("best_params", {}).items()):
             out.append(f"  {key}: {value}")
     if stats.get("start_time"):
-        out.append(f"start time: {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(stats['start_time']))}")
+        started = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(stats["start_time"]))
+        out.append(f"start time: {started}")
     if stats.get("duration") is not None:
         out.append(f"duration: {stats['duration']:.1f}s")
 

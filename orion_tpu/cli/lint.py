@@ -1,0 +1,97 @@
+"""`orion-tpu lint`: check the project's own invariants statically.
+
+No reference counterpart — the TPU build's conventions (retrace-free
+fused steps, retry-covered storage ops, allocation-free disabled
+telemetry, acyclic lock order) are enforceable from the AST, and this
+command is how CI and the bench preflight enforce them
+(``orion_tpu.analysis``; rule catalog in ``docs/static_analysis.md``).
+Exit code 0 = clean, 1 = violations found, 2 = bad path argument.
+"""
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser(
+        "lint", help="statically check orion-tpu invariant conventions"
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        metavar="path",
+        help="files or directories to lint (default: the installed "
+        "orion_tpu package)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="RULES",
+        help="comma-separated rule-id prefixes to run (e.g. JIT,STO002)",
+    )
+    parser.add_argument(
+        "--ignore",
+        default=None,
+        metavar="RULES",
+        help="comma-separated rule-id prefixes to skip",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("human", "json"),
+        default="human",
+        help="output format (default: human)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.set_defaults(func=main)
+    return parser
+
+
+def _split(value):
+    return [part.strip() for part in value.split(",") if part.strip()] if value else None
+
+
+def main(args):
+    import os
+
+    from orion_tpu.analysis import format_human, format_json, rule_catalog, run_lint
+
+    if args.list_rules:
+        for rule_id, name, description in rule_catalog():
+            print(f"{rule_id}  {name}")
+            print(f"    {description}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        import orion_tpu
+
+        paths = [os.path.dirname(os.path.abspath(orion_tpu.__file__))]
+    try:
+        diagnostics = run_lint(
+            paths, select=_split(args.select), ignore=_split(args.ignore)
+        )
+    except ValueError as exc:  # typo'd --select/--ignore prefix
+        import sys
+
+        print(f"ERROR: {exc}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(format_json(diagnostics))
+    else:
+        print(format_human(diagnostics))
+    # A bad ARGUMENT (missing path, non-Python file, empty directory) is a
+    # usage error, not a lint verdict: exit 2, keyed off the engine's own
+    # LNT003 findings so path validation lives in ONE place (run_lint) and
+    # the tree is walked exactly once per invocation.  An LNT003 on a file
+    # merely discovered under a valid directory argument (e.g. permission
+    # denied mid-walk) is a lint finding like any other: exit 1.
+    from orion_tpu.analysis.engine import UNREADABLE_PATH
+
+    arg_paths = set(paths)
+    if any(
+        d.rule_id == UNREADABLE_PATH and d.path in arg_paths for d in diagnostics
+    ):
+        return 2
+    return 1 if diagnostics else 0

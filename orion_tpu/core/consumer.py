@@ -31,7 +31,8 @@ log = logging.getLogger(__name__)
 
 
 class Consumer:
-    def __init__(self, experiment, cmdline_parser, heartbeat_interval=60.0, interrupt_signal_code=130):
+    def __init__(self, experiment, cmdline_parser, heartbeat_interval=60.0,
+                 interrupt_signal_code=130):
         self.experiment = experiment
         self.parser = cmdline_parser
         self.heartbeat_interval = heartbeat_interval

@@ -227,9 +227,13 @@ class Producer:
         ``producer.{op}`` span + histogram entry (one clock reading, two
         sinks) — the storage-persisted timing channel is unchanged."""
         self._pending_timings.append((op, duration, count))
-        TELEMETRY.record_span(
-            f"producer.{op}", duration=duration, args={"count": count}
-        )
+        # Guarded: the span name f-string and args dict must not be
+        # allocated per sample when telemetry is off — this runs inside
+        # every produce()/update() round.
+        if TELEMETRY.enabled:
+            TELEMETRY.record_span(
+                f"producer.{op}", duration=duration, args={"count": count}
+            )
 
     def _flush_timings(self, force_metrics=False):
         """Telemetry must never break the run (SURVEY §5 timing hooks).
@@ -485,7 +489,11 @@ class Producer:
         """Close the open ``device.dispatch`` span (if any): the async device
         work window from speculative dispatch to finalize/discard."""
         t0, self._spec_window_t0 = self._spec_window_t0, None
-        if t0 is not None:
+        # t0 is only ever stamped with telemetry enabled, but the args dict
+        # below must provably not allocate on the disabled path, so the
+        # guard is explicit (it also closes the window cleanly if the
+        # registry was disabled mid-run).
+        if t0 is not None and TELEMETRY.enabled:
             TELEMETRY.record_span(
                 "device.dispatch", start=t0, args={"outcome": outcome}
             )

@@ -10,7 +10,7 @@ Space codec produces from their params).
 
 import hashlib
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 ALL_STATUSES = (

@@ -103,7 +103,6 @@ class ExperimentBranchBuilder:
 def branch_experiment(storage, parent, new_priors, branch_config=None, **config):
     """Create a child experiment from ``parent`` with the changed config."""
     from orion_tpu.core.experiment import Experiment
-    from orion_tpu.core.trial import Trial
 
     branch_config = dict(branch_config or {})
     old_config = parent.configuration()

@@ -129,7 +129,8 @@ class Dimension:
         return vals.reshape((n,) + self.shape)
 
     def __repr__(self):
-        return f"{type(self).__name__}(name={self.name}, prior={self.prior_expr}, shape={self.shape})"
+        return (f"{type(self).__name__}(name={self.name}, "
+                f"prior={self.prior_expr}, shape={self.shape})")
 
 
 @dataclass(frozen=True, repr=False)

@@ -277,7 +277,7 @@ class DocumentStorage(BaseStorage):
         self._db.ensure_indexes(INDEX_SPECS)
 
     # --- experiments --------------------------------------------------------
-    @_retrying("create_experiment")
+    @_retrying("create_experiment", mode=MODE_ALWAYS)
     def create_experiment(self, config):
         """Insert a new experiment config; DuplicateKeyError if (name, version)
         already exists — callers translate that into a RaceCondition retry.
@@ -290,7 +290,7 @@ class DocumentStorage(BaseStorage):
         config["_id"] = _id
         return config
 
-    @_retrying("update_experiment")
+    @_retrying("update_experiment", mode=MODE_ALWAYS)
     def update_experiment(self, experiment=None, uid=None, where=None, **kwargs):
         query = dict(where or {})
         if uid is not None:
@@ -305,25 +305,25 @@ class DocumentStorage(BaseStorage):
             )
         return self._db.write("experiments", kwargs, query=query)
 
-    @_retrying("fetch_experiments")
+    @_retrying("fetch_experiments", mode=MODE_ALWAYS)
     def fetch_experiments(self, query, projection=None):
         return self._db.read("experiments", query, projection)
 
     # --- trials -------------------------------------------------------------
-    @_traced("register_trial")
+    @_traced("register_trial", retry=MODE_ALWAYS)
     def register_trial(self, trial):
         """Insert a new trial; DuplicateKeyError on a duplicate point id."""
         trial.submit_time = trial.submit_time or time.time()
         self._db.write("trials", trial.to_dict())
         return trial
 
-    @_retrying("register_lie")
+    @_retrying("register_lie", mode=MODE_ALWAYS)
     def register_lie(self, trial):
         trial.submit_time = trial.submit_time or time.time()
         self._db.write("lying_trials", trial.to_dict())
         return trial
 
-    @_retrying("fetch_lies")
+    @_retrying("fetch_lies", mode=MODE_ALWAYS)
     def fetch_lies(self, experiment):
         docs = self._db.read("lying_trials", {"experiment": _exp_id(experiment)})
         return [Trial.from_dict(d) for d in docs]
@@ -349,7 +349,7 @@ class DocumentStorage(BaseStorage):
         }
         return query, update
 
-    @_traced("reserve_trial")
+    @_traced("reserve_trial", retry=MODE_ALWAYS)
     def reserve_trial(self, experiment):
         """Atomically claim one pending trial (the cross-worker sync point;
         reference `legacy.py:253-273`)."""
@@ -379,7 +379,7 @@ class DocumentStorage(BaseStorage):
             return apply_batch(ops)
         return self._db.pipeline(ops)
 
-    @_traced("reserve_trials")
+    @_traced("reserve_trials", retry=MODE_ALWAYS)
     def reserve_trials(self, experiment, num):
         """Claim up to ``num`` pending trials; each claim is individually
         atomic (repeated find-one-and-updates — every op sees the previous
@@ -433,7 +433,7 @@ class DocumentStorage(BaseStorage):
         # surface on the next (empty-handed) round.
         return out
 
-    @_traced("register_trials", span_name="storage.commit")
+    @_traced("register_trials", span_name="storage.commit", retry=MODE_ALWAYS)
     def register_trials(self, trials):
         """Batch-register; returns one outcome per trial: the trial itself on
         success or the per-trial exception (DuplicateKeyError for an
@@ -455,7 +455,7 @@ class DocumentStorage(BaseStorage):
             for trial, result in zip(trials, results)
         ]
 
-    @_traced("update_completed_trials")
+    @_traced("update_completed_trials", retry=MODE_ALWAYS)
     def update_completed_trials(self, pairs):
         """Batch-complete ``[(trial, results), ...]`` — one backend round
         (one transaction on SQL, one wire request on the network driver);
@@ -497,14 +497,14 @@ class DocumentStorage(BaseStorage):
                 outcomes.append(trial)
         return outcomes
 
-    @_traced("fetch_trials")
+    @_traced("fetch_trials", retry=MODE_ALWAYS)
     def fetch_trials(self, experiment=None, uid=None):
         query = {"experiment": uid if uid is not None else _exp_id(experiment)}
         docs = self._db.read("trials", query)
         docs.sort(key=_trial_doc_order)
         return [Trial.from_dict(d) for d in docs]
 
-    @_retrying("read_trial_docs")
+    @_retrying("read_trial_docs", mode=MODE_ALWAYS)
     def read_trial_docs(self, uid, ids=None, projection=None):
         """Raw trial documents for an experiment, optionally id-filtered and
         projected.  The supported read path for consumers that need
@@ -517,7 +517,7 @@ class DocumentStorage(BaseStorage):
             query["_id"] = {"$in": list(ids)}
         return self._db.read("trials", query, projection=projection)
 
-    @_traced("fetch_update_view")
+    @_traced("fetch_update_view", retry=MODE_ALWAYS)
     def fetch_update_view(self, experiment, known_completed=-1):
         """The producer's per-round sync snapshot: ``(trials, n_completed)``.
 
@@ -565,7 +565,7 @@ class DocumentStorage(BaseStorage):
         docs = sorted(by_id.values(), key=_trial_doc_order)
         return [Trial.from_dict(d) for d in docs], n_completed
 
-    @_retrying("fetch_trials_by_status")
+    @_retrying("fetch_trials_by_status", mode=MODE_ALWAYS)
     def fetch_trials_by_status(self, experiment, status):
         statuses = [status] if isinstance(status, str) else list(status)
         docs = self._db.read(
@@ -574,7 +574,7 @@ class DocumentStorage(BaseStorage):
         )
         return [Trial.from_dict(d) for d in docs]
 
-    @_retrying("get_trial")
+    @_retrying("get_trial", mode=MODE_ALWAYS)
     def get_trial(self, trial=None, uid=None):
         _id = uid if uid is not None else trial.id
         docs = self._db.read("trials", {"_id": _id})
@@ -630,7 +630,7 @@ class DocumentStorage(BaseStorage):
         trial.status = status
         return Trial.from_dict(doc)
 
-    @_traced("update_heartbeat")
+    @_traced("update_heartbeat", retry=MODE_ALWAYS)
     def update_heartbeat(self, trial):
         doc = self._db.read_and_write(
             "trials",
@@ -640,7 +640,7 @@ class DocumentStorage(BaseStorage):
         if doc is None:
             raise FailedUpdate(f"trial {trial.id} is no longer reserved")
 
-    @_retrying("fetch_lost_trials")
+    @_retrying("fetch_lost_trials", mode=MODE_ALWAYS)
     def fetch_lost_trials(self, experiment, timeout):
         """Reserved trials whose worker stopped heartbeating (crashed/killed)."""
         threshold = time.time() - timeout
@@ -654,7 +654,7 @@ class DocumentStorage(BaseStorage):
         )
         return [Trial.from_dict(d) for d in docs]
 
-    @_retrying("push_trial_results")
+    @_retrying("push_trial_results", mode=MODE_ALWAYS)
     def push_trial_results(self, trial):
         doc = self._db.read_and_write(
             "trials",
@@ -665,7 +665,7 @@ class DocumentStorage(BaseStorage):
             raise FailedUpdate(f"cannot push results of non-reserved trial {trial.id}")
         return Trial.from_dict(doc)
 
-    @_traced("update_completed_trial")
+    @_traced("update_completed_trial", retry=MODE_ALWAYS)
     def update_completed_trial(self, trial, results):
         trial.results = list(results)
         trial.end_time = time.time()
@@ -683,13 +683,13 @@ class DocumentStorage(BaseStorage):
         trial.status = "completed"
         return trial
 
-    @_retrying("count_completed_trials")
+    @_retrying("count_completed_trials", mode=MODE_ALWAYS)
     def count_completed_trials(self, experiment):
         return self._db.count(
             "trials", {"experiment": _exp_id(experiment), "status": "completed"}
         )
 
-    @_retrying("count_broken_trials")
+    @_retrying("count_broken_trials", mode=MODE_ALWAYS)
     def count_broken_trials(self, experiment):
         return self._db.count(
             "trials", {"experiment": _exp_id(experiment), "status": "broken"}
@@ -710,6 +710,16 @@ class DocumentStorage(BaseStorage):
         file backend — on the producer's hot path)."""
         if not samples:
             return
+        self._append_timings(experiment, samples)
+        self._prune_timings(experiment)
+
+    # Append leg: a lost-reply re-send would duplicate samples, so the
+    # ambiguous case gives up (mode="unapplied") — losing one flush beats
+    # double-counting it, and the next round flushes fresh data anyway.
+    # The prune leg retries separately so ITS transient failure can never
+    # re-run an append that already landed.
+    @_retrying("record_timings", mode=MODE_UNAPPLIED)
+    def _append_timings(self, experiment, samples):
         now = time.time()
         exp_id = _exp_id(experiment)
         self._db.write(
@@ -725,15 +735,29 @@ class DocumentStorage(BaseStorage):
                 for op, duration, count in samples
             ],
         )
+
+    # Count/read/remove-below-cutoff all converge under re-application.
+    # Raw _db reads, not fetch_timings/fetch_spans: the fetchers carry
+    # their own @_retrying, and nesting two policies would compound to
+    # max_attempts**2 backend attempts during a sustained outage.
+    @_retrying("record_timings.prune", mode=MODE_ALWAYS)
+    def _prune_timings(self, experiment):
+        exp_id = _exp_id(experiment)
         n = self._db.count("telemetry", {"experiment": exp_id})
         if n > self.TELEMETRY_CAP:
-            docs = self.fetch_timings(experiment)  # time-sorted ascending
-            cutoff = docs[n - self.TELEMETRY_CAP]["time"]
+            docs = self._db.read("telemetry", {"experiment": exp_id})
+            # Index off the re-read list, not the earlier count: another
+            # worker's prune can land between count() and read().
+            if len(docs) <= self.TELEMETRY_CAP:
+                return
+            docs.sort(key=lambda d: d.get("time") or 0.0)
+            cutoff = docs[len(docs) - self.TELEMETRY_CAP].get("time") or 0.0
             self._db.remove(
                 "telemetry",
                 {"experiment": exp_id, "time": {"$lt": cutoff}},
             )
 
+    @_retrying("fetch_timings", mode=MODE_ALWAYS)
     def fetch_timings(self, experiment, op=None):
         query = {"experiment": _exp_id(experiment)}
         if op is not None:
@@ -747,6 +771,9 @@ class DocumentStorage(BaseStorage):
     #: unbounded-growth guard as TELEMETRY_CAP for timing samples).
     SPANS_CAP = 20000
 
+    # Upsert keyed by (experiment, worker): re-applying after an ambiguous
+    # loss converges on the same latest-snapshot doc, so retry always.
+    @_retrying("record_metrics", mode=MODE_ALWAYS)
     def record_metrics(self, experiment, snapshot, worker=None):
         """Upsert one worker's metrics snapshot (``Telemetry.snapshot()``)
         keyed by (experiment, worker) — counters/histograms are per-worker
@@ -769,6 +796,7 @@ class DocumentStorage(BaseStorage):
         if not updated:
             self._db.write("metrics", doc)
 
+    @_retrying("fetch_metrics", mode=MODE_ALWAYS)
     def fetch_metrics(self, experiment):
         docs = self._db.read("metrics", {"experiment": _exp_id(experiment)})
         docs.sort(key=lambda d: d.get("time") or 0.0)
@@ -779,12 +807,24 @@ class DocumentStorage(BaseStorage):
         backend write; prunes the oldest past :attr:`SPANS_CAP`."""
         if not spans:
             return
+        self._append_spans(experiment, spans)
+        self._prune_spans(experiment)
+
+    # Append leg, same contract as record_timings: ambiguous losses give up
+    # instead of risking duplicated span records, and the prune retries
+    # separately so it cannot re-run a landed append.
+    @_retrying("record_spans", mode=MODE_UNAPPLIED)
+    def _append_spans(self, experiment, spans):
         exp_id = _exp_id(experiment)
         worker = _worker_id()
         self._db.write(
             "spans",
             [{"experiment": exp_id, "worker": worker, **span} for span in spans],
         )
+
+    @_retrying("record_spans.prune", mode=MODE_ALWAYS)
+    def _prune_spans(self, experiment):
+        exp_id = _exp_id(experiment)
         n = self._db.count("spans", {"experiment": exp_id})
         if n > self.SPANS_CAP:
             # Prune with hysteresis — down to 90% of the cap, not exactly
@@ -793,18 +833,24 @@ class DocumentStorage(BaseStorage):
             # producer's hot path; the 10% slack amortizes it to one prune
             # per ~2k spans.
             keep = max(1, int(self.SPANS_CAP * 0.9))
-            docs = self.fetch_spans(experiment)  # ts-sorted ascending
-            cutoff = docs[n - keep].get("ts") or 0.0
+            docs = self._db.read("spans", {"experiment": exp_id})
+            # Index off the re-read list, not the earlier count: another
+            # worker's prune can land between count() and read().
+            if len(docs) <= keep:
+                return
+            docs.sort(key=lambda d: d.get("ts") or 0.0)
+            cutoff = docs[len(docs) - keep].get("ts") or 0.0
             self._db.remove(
                 "spans", {"experiment": exp_id, "ts": {"$lt": cutoff}}
             )
 
+    @_retrying("fetch_spans", mode=MODE_ALWAYS)
     def fetch_spans(self, experiment):
         docs = self._db.read("spans", {"experiment": _exp_id(experiment)})
         docs.sort(key=lambda d: d.get("ts") or 0.0)
         return docs
 
-    @_retrying("fetch_noncompleted_trials")
+    @_retrying("fetch_noncompleted_trials", mode=MODE_ALWAYS)
     def fetch_noncompleted_trials(self, experiment):
         docs = self._db.read(
             "trials",

@@ -130,12 +130,15 @@ class RetryPolicy:
         duration = self.delay(attempt)
         if duration > 0.0:
             self._sleep(duration)
-        TELEMETRY.record_span(
-            span,
-            duration=duration,
-            args={"op": op, "attempt": attempt},
-            histogram=False,
-        )
+        # Guarded: the args dict must not be allocated when telemetry is
+        # off — backoff sleeps sit inside the storage retry hot path.
+        if TELEMETRY.enabled:
+            TELEMETRY.record_span(
+                span,
+                duration=duration,
+                args={"op": op, "attempt": attempt},
+                histogram=False,
+            )
         return duration
 
     def run(self, fn, op="storage", mode=MODE_ALWAYS):

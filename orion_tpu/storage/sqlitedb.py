@@ -26,7 +26,6 @@ from orion_tpu.storage.documents import (
     apply_update,
     dumps_canonical as _dumps,
     index_key as _index_key,
-    _get_path,
     _matches,
     _project,
 )

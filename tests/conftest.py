@@ -31,6 +31,19 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 import numpy as np
 import pytest
 
+# THE repo-root discovery — shared by every test that shells out to repo
+# files (bench.py, __graft_entry__.py, docs/commands.md) and by the lint
+# self-test, replacing the per-file dirname/dirname/dirname chains that
+# silently break when a test file moves one directory deeper.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="session")
+def repo_root():
+    """Absolute path of the repository root (the directory holding
+    ``bench.py``/``orion_tpu``/``docs``)."""
+    return _REPO_ROOT
+
 
 @pytest.fixture(autouse=True)
 def _isolate_user_config(tmp_path, monkeypatch):

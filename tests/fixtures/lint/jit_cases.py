@@ -1,0 +1,81 @@
+"""Lint fixture: JIT/retrace hygiene (JIT001–JIT003).
+
+Never imported — linted as source by tests/unit/test_lint_rules.py.  Lines
+carrying ``# expect: RULE_ID`` must produce exactly those diagnostics;
+every other line must stay quiet (the good patterns are the negative
+cases).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_host_sync(x):
+    v = x.sum().item()  # expect: JIT001
+    x.block_until_ready()  # expect: JIT001
+    f = float(x)  # expect: JIT001
+    arr = np.abs(x)  # expect: JIT001
+    return v + f + arr
+
+
+@partial(jax.jit, static_argnums=(1,))
+def good_static_concretize(x, n):
+    # int() over a *static* parameter is host bookkeeping, not a sync.
+    scale = int(n * 2)
+    return x * scale
+
+
+@jax.jit
+def bad_branch(x, flag):
+    if x > 0:  # expect: JIT002
+        return x
+    while flag:  # expect: JIT002
+        x = x - 1
+    return x
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def good_branch(x, mode):
+    if mode == "relu":  # static parameter: python branching is fine
+        return jnp.maximum(x, 0.0)
+    if x is None:  # is-None probe never inspects the traced value
+        return jnp.zeros(())
+    return jnp.where(x > 0, x, 0.0)
+
+
+def _impl(x, y):
+    return x + y
+
+
+# Wrapper form: marks _impl as jit-compiled without a decorator.
+_wrapped = jax.jit(_impl)
+
+
+@jax.jit
+def bad_wrapped_sync(x, y):
+    return _impl(x, y).tolist()  # expect: JIT001
+
+
+@partial(jax.jit, static_argnums=(1,))
+def scaled(x, n, offset):
+    return x * n + offset
+
+
+def host_caller_bad(x):
+    # 4 rides the static slot (pinned — fine); 0.5 lands in a traced slot
+    # as a weak-typed python scalar and forks the jit cache signature.
+    return scaled(x, 4, 0.5)  # expect: JIT003
+
+
+def host_caller_good(x):
+    return scaled(x, 4, jnp.asarray(0.5, jnp.float32))
+
+
+@jax.jit
+def jit_caller_good(x):
+    # jit-to-jit: the literal is constant-folded into the trace.
+    return scaled(x, 4, 0.5)

@@ -1,0 +1,20 @@
+"""Lint fixture: a suppression WITHOUT a reason is itself a violation.
+
+Never imported — checked by a dedicated test (not the annotation-driven
+table): the reasonless disable comment below must produce LNT001 on its
+own line AND fail to silence the TEL003 it tried to cover.
+"""
+
+
+class _Registry:
+    enabled = False
+
+    def record_span(self, name, **kwargs):
+        pass
+
+
+TELEMETRY = _Registry()
+
+
+def reasonless_suppression(n):
+    TELEMETRY.record_span("step", args={"n": n})  # lint: disable=TEL003

@@ -1,0 +1,86 @@
+"""Lint fixture: storage retry/trace coverage (STO001–STO003).
+
+Never imported — linted as source by tests/unit/test_lint_rules.py.
+Self-contained stand-ins for the real storage layer: the rules match on
+names (DocumentStorage base, _traced/_retrying decorators, DatabaseError),
+not on imports.
+"""
+
+MODE_ALWAYS = "always"
+MODE_UNAPPLIED = "unapplied"
+
+
+class DatabaseError(Exception):
+    pass
+
+
+def _traced(op, span_name=None, retry=MODE_ALWAYS):
+    def decorate(fn):
+        return fn
+
+    return decorate
+
+
+def _retrying(op, mode=MODE_ALWAYS):
+    def decorate(fn):
+        return fn
+
+    return decorate
+
+
+class DocumentStorage:
+    pass
+
+
+class GoodStorage(DocumentStorage):
+    @_traced("fetch_stuff", retry=MODE_ALWAYS)
+    def fetch_stuff(self):
+        return self._db.read("stuff")
+
+    @_retrying("read_notes", mode=MODE_UNAPPLIED)
+    def read_notes(self):
+        return self._db.read("notes")
+
+    def derived(self):
+        # No self._db access: free to skip the decorators.
+        return self.fetch_stuff() + self.read_notes()
+
+    def _private_helper(self):
+        # Private helpers are the decorated ops' building blocks.
+        return self._db.count("stuff")
+
+
+class BadStorage(DocumentStorage):
+    def fetch_bad(self):  # expect: STO001
+        return self._db.read("stuff")
+
+    @_retrying("implicit")
+    def implicit_mode(self):  # expect: STO002
+        return self._db.read("stuff")
+
+    @_traced("implicit_traced")
+    def implicit_traced(self):  # expect: STO002
+        return self._db.write("stuff", {})
+
+
+class WireClient:
+    def send_good(self, payload):
+        self._sock.sendall(payload)
+        error = DatabaseError("connection lost mid-request")
+        error.maybe_applied = True
+        raise error
+
+    def send_bad_inline(self, payload):
+        self._sock.sendall(payload)
+        raise DatabaseError("connection lost")  # expect: STO003
+
+    def send_bad_variable(self, payload):
+        self._sock.sendall(payload)
+        error = DatabaseError("connection lost")
+        raise error  # expect: STO003
+
+    def no_wire(self, doc):
+        # Not a send function: plain validation errors carry no
+        # applied-or-not ambiguity.
+        if not doc:
+            raise DatabaseError("empty document")

@@ -1,0 +1,31 @@
+"""Lint fixture: suppression honored when it carries a reason.
+
+Never imported — linted as source by tests/unit/test_lint_rules.py.  Both
+violations below are silenced by reasoned suppressions (one inline, one on
+the standalone line above), so the whole file must lint clean — the
+table test's expectation set for this file is empty.
+"""
+
+
+class _Registry:
+    enabled = False
+
+    def count(self, name, n=1):
+        pass
+
+    def record_span(self, name, **kwargs):
+        pass
+
+
+TELEMETRY = _Registry()
+
+
+def suppressed_inline(n):
+    TELEMETRY.record_span("step", args={"n": n})  # lint: disable=TEL003 -- fixture: proving inline suppressions are honored
+
+
+def suppressed_above(items):
+    if TELEMETRY.enabled:
+        for item in items:
+            # lint: disable=TEL001 -- fixture: proving standalone-line suppressions cover the next line
+            TELEMETRY.count(f"op.{item}")

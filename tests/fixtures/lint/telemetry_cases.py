@@ -1,0 +1,80 @@
+"""Lint fixture: telemetry discipline (TEL001–TEL003).
+
+Never imported — linted as source by tests/unit/test_lint_rules.py.  The
+``TELEMETRY`` stand-in matches the registry the rules key on.
+"""
+
+
+class _Registry:
+    enabled = False
+
+    def count(self, name, n=1):
+        pass
+
+    def observe(self, name, seconds):
+        pass
+
+    def set_gauge(self, name, value):
+        pass
+
+    def record_span(self, name, **kwargs):
+        pass
+
+    def span(self, name, args=None):
+        pass
+
+
+TELEMETRY = _Registry()
+
+
+def bad_dynamic_key_in_loop(items):
+    if TELEMETRY.enabled:
+        for item in items:
+            TELEMETRY.count(f"op.{item}")  # expect: TEL001
+
+
+def good_constant_key_in_loop(items):
+    for _item in items:
+        TELEMETRY.count("op.total")
+
+
+def good_hoisted_key(items, key):
+    for item in items:
+        TELEMETRY.observe(key, item)
+
+
+def bad_unmanaged_span():
+    span = TELEMETRY.span("work")  # expect: TEL002
+    span.__enter__()
+    return span
+
+
+def good_managed_span():
+    with TELEMETRY.span("work"):
+        return 1
+
+
+def bad_unguarded_allocation(n):
+    TELEMETRY.record_span("step", args={"n": n})  # expect: TEL003
+
+
+def good_guarded_allocation(n):
+    if TELEMETRY.enabled:
+        TELEMETRY.record_span("step", args={"n": n})
+
+
+def good_sentinel_guard(n, clock):
+    t0 = clock() if TELEMETRY.enabled else None
+    if t0 is not None:
+        TELEMETRY.record_span("step", start=t0, args={"n": n})
+
+
+def good_early_return_guard(n):
+    if not TELEMETRY.enabled:
+        return
+    TELEMETRY.record_span("step", args={"n": n})
+
+
+def good_plain_args(seconds):
+    # Constant name + scalar arg: nothing allocated, no guard needed.
+    TELEMETRY.observe("step.duration", seconds)

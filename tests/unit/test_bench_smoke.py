@@ -14,10 +14,6 @@ import os
 import subprocess
 import sys
 
-REPO_ROOT = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-
 BREAKDOWN_KEYS = (
     "encode",
     "upload",
@@ -50,13 +46,13 @@ def _retrace_introspection_available():
     return hasattr(_suggest_step, "_cache_size")
 
 
-def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path):
+def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     trace_path = tmp_path / "trace.json"
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
         [
             sys.executable,
-            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(repo_root, "bench.py"),
             "--smoke",
             "--trace-out",
             str(trace_path),
@@ -65,11 +61,14 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path):
         text=True,
         timeout=560,
         env=env,
-        cwd=REPO_ROOT,
+        cwd=repo_root,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
     assert payload["smoke"] is True
+    # The --smoke preflight self-lints the tree before timing anything:
+    # bench numbers must never be taken on a contract-violating tree.
+    assert payload["lint_violations"] == 0
     # The emitted line itself must carry the breakdown + storage keys —
     # r05's recorded line lacked them, and only an assertion on the payload
     # (not just on values we happen to index) pins the schema.
@@ -121,7 +120,7 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path):
     ), "storage.commit no longer overlaps the device.dispatch window"
 
 
-def test_bench_chaos_smoke_reports_retries_and_audits_clean():
+def test_bench_chaos_smoke_reports_retries_and_audits_clean(repo_root):
     """``bench.py --chaos``: the seeded fault schedules fire, the retry
     policy absorbs them (storage.retries > 0 on the faulted sqlite run,
     reconnects > 0 through the fault proxy), and the invariant auditor
@@ -129,12 +128,12 @@ def test_bench_chaos_smoke_reports_retries_and_audits_clean():
     pins the emitted schema on top."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--chaos"],
+        [sys.executable, os.path.join(repo_root, "bench.py"), "--chaos"],
         capture_output=True,
         text=True,
         timeout=560,
         env=env,
-        cwd=REPO_ROOT,
+        cwd=repo_root,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(proc.stdout.strip().splitlines()[-1])

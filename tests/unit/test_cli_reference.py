@@ -3,12 +3,10 @@
 import os
 
 
-def test_commands_md_is_current():
+def test_commands_md_is_current(repo_root):
     from orion_tpu.cli.docgen import generate_markdown
 
-    path = os.path.join(
-        os.path.dirname(__file__), "..", "..", "docs", "commands.md"
-    )
+    path = os.path.join(repo_root, "docs", "commands.md")
     with open(path) as handle:
         checked_in = handle.read()
     assert checked_in == generate_markdown(), (

@@ -286,8 +286,14 @@ def test_netdb_pipeline_cut_mid_batch_applies_exact_prefix(proxied_netdb):
         db.pipeline(_batch_insert_ops(3))
     assert err.value.maybe_applied
     # Exactly the first request line survived the "restart"; the torn
-    # remainder was dropped by the server's readline guard.
+    # remainder was dropped by the server's readline guard.  The client's
+    # error races the server thread still applying that delivered line, so
+    # poll for it rather than assuming instantaneous server-side apply.
+    deadline = time.monotonic() + 5.0
     docs = server.db.read("docs")
+    while not docs and time.monotonic() < deadline:
+        time.sleep(0.01)
+        docs = server.db.read("docs")
     assert [d["_id"] for d in docs] == [0]
     # Recovery: resend the whole batch — slot 0 dedups, the rest applies.
     outcomes = db.pipeline(_batch_insert_ops(3))

@@ -1,0 +1,610 @@
+"""Table test for every ``orion-tpu lint`` rule family.
+
+Each fixture under ``tests/fixtures/lint/`` is linted as source and its
+``# expect: RULE_ID[,RULE_ID...]`` annotations are compared EXACTLY
+against the produced diagnostics — both directions: every annotated line
+must fire with exactly those rule ids, and every unannotated line must
+stay quiet (the fixtures' good patterns are the negative cases —
+suppression honored, static-pinned scalar not flagged, guarded telemetry
+allocation, single-writer attribute).
+"""
+
+import os
+import re
+
+import pytest
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9_,\s]+?)\s*$")
+
+_FIXTURE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "fixtures",
+    "lint",
+)
+
+#: Handled by dedicated tests below, not the annotation table (its
+#: reasonless disable comment cannot carry an expect annotation too).
+_TABLE_EXCLUDED = {"malformed_suppression.py"}
+
+_TABLE_FIXTURES = sorted(
+    name
+    for name in os.listdir(_FIXTURE_DIR)
+    if name.endswith(".py") and name not in _TABLE_EXCLUDED
+)
+
+
+def _expected_diagnostics(path):
+    expected = {}
+    with open(path) as handle:
+        for lineno, line in enumerate(handle, start=1):
+            match = _EXPECT_RE.search(line)
+            if match:
+                expected[lineno] = {
+                    part.strip()
+                    for part in match.group(1).split(",")
+                    if part.strip()
+                }
+    return expected
+
+
+def _actual_diagnostics(path):
+    from orion_tpu.analysis import run_lint
+
+    actual = {}
+    for diag in run_lint([path]):
+        actual.setdefault(diag.line, set()).add(diag.rule_id)
+    return actual
+
+
+@pytest.mark.parametrize("fixture", _TABLE_FIXTURES)
+def test_fixture_produces_exactly_the_annotated_diagnostics(fixture):
+    path = os.path.join(_FIXTURE_DIR, fixture)
+    expected = _expected_diagnostics(path)
+    actual = _actual_diagnostics(path)
+    missing = {
+        line: ids - actual.get(line, set())
+        for line, ids in expected.items()
+        if ids - actual.get(line, set())
+    }
+    unexpected = {
+        line: ids - expected.get(line, set())
+        for line, ids in actual.items()
+        if ids - expected.get(line, set())
+    }
+    assert not missing, f"{fixture}: annotated rules did not fire: {missing}"
+    assert not unexpected, f"{fixture}: unannotated diagnostics: {unexpected}"
+
+
+def test_every_rule_family_is_covered_by_a_fixture():
+    """The table must exercise all four families (plus stay honest if a
+    rule is added without a fixture: its id must appear in some
+    annotation)."""
+    from orion_tpu.analysis import rule_catalog
+
+    annotated = set()
+    for fixture in _TABLE_FIXTURES:
+        for ids in _expected_diagnostics(
+            os.path.join(_FIXTURE_DIR, fixture)
+        ).values():
+            annotated |= ids
+    for rule_id, _name, _description in rule_catalog():
+        assert rule_id in annotated, (
+            f"rule {rule_id} has no firing fixture under tests/fixtures/lint/"
+        )
+
+
+def test_reasonless_suppression_is_flagged_and_not_honored():
+    path = os.path.join(_FIXTURE_DIR, "malformed_suppression.py")
+    actual = _actual_diagnostics(path)
+    flagged = {rule for rules in actual.values() for rule in rules}
+    # The reasonless disable is itself a violation...
+    assert "LNT001" in flagged
+    # ...and does NOT silence the rule it named.
+    assert "TEL003" in flagged
+
+
+def test_select_and_ignore_filter_by_prefix():
+    from orion_tpu.analysis import run_lint
+
+    path = os.path.join(_FIXTURE_DIR, "telemetry_cases.py")
+    everything = {d.rule_id for d in run_lint([path])}
+    assert {"TEL001", "TEL002", "TEL003"} <= everything
+    only_spans = {d.rule_id for d in run_lint([path], select=["TEL002"])}
+    assert only_spans == {"TEL002"}
+    no_spans = {d.rule_id for d in run_lint([path], ignore=["TEL002"])}
+    assert "TEL002" not in no_spans and "TEL001" in no_spans
+
+
+def test_json_output_schema():
+    from orion_tpu.analysis import format_json, run_lint
+
+    import json
+
+    path = os.path.join(_FIXTURE_DIR, "lock_cases.py")
+    payload = json.loads(format_json(run_lint([path])))
+    assert payload["count"] == len(payload["violations"]) > 0
+    first = payload["violations"][0]
+    assert set(first) == {"path", "line", "col", "rule", "message"}
+
+
+_STACKED_CALL = (
+    "def noisy(items):\n"
+    "    for item in items:\n"
+    "        {above}"
+    "        TELEMETRY.count(f\"op.{{item}}\"){inline}\n"
+)
+
+
+def test_stacked_standalone_and_inline_suppressions_both_hold(tmp_path):
+    """A line covered by BOTH a standalone suppression above and an inline
+    one must honor both — the engine merges them instead of letting the
+    inline comment overwrite the standalone's rules."""
+    from orion_tpu.analysis import run_lint
+
+    bare = tmp_path / "bare.py"
+    bare.write_text(_STACKED_CALL.format(above="", inline=""))
+    fired = {d.rule_id for d in run_lint([str(bare)])}
+    assert fired == {"TEL001", "TEL003"}  # the premise: two rules, one line
+
+    both = tmp_path / "both.py"
+    both.write_text(
+        _STACKED_CALL.format(
+            above="# lint: disable=TEL001 -- test: key set is bounded\n",
+            inline="  # lint: disable=TEL003 -- test: cold path",
+        )
+    )
+    assert run_lint([str(both)]) == []
+
+    # Two stacked standalone comments: the first must reach past the
+    # second to the code line, and a blank line below them is skipped too.
+    stacked = tmp_path / "stacked.py"
+    stacked.write_text(
+        _STACKED_CALL.format(
+            above=(
+                "# lint: disable=TEL001 -- test: key set is bounded\n"
+                "        # lint: disable=TEL003 -- test: cold path\n"
+                "\n"
+            ),
+            inline="",
+        )
+    )
+    assert run_lint([str(stacked)]) == []
+
+
+def test_run_lint_surfaces_bad_paths_instead_of_crashing_or_passing(tmp_path):
+    """run_lint is the whole API for direct callers: a typo'd path must
+    come back as an LNT003 finding, not a crash (missing .py) and not a
+    silently clean run (misspelled directory / non-Python file)."""
+    from orion_tpu.analysis import run_lint
+
+    missing = run_lint([str(tmp_path / "no_such_file.py")])
+    assert [d.rule_id for d in missing] == ["LNT003"]
+
+    empty_dir = tmp_path / "typo_dir"
+    empty_dir.mkdir()
+    assert [d.rule_id for d in run_lint([str(empty_dir)])] == ["LNT003"]
+
+    data = tmp_path / "data.txt"
+    data.write_text("not python\n")
+    assert [d.rule_id for d in run_lint([str(data)])] == ["LNT003"]
+
+
+def test_standalone_suppression_above_decorator_reaches_the_def_line(tmp_path):
+    """STO/JIT diagnostics anchor at the def line; a standalone suppression
+    written above a decorated function lands on the decorator line and must
+    chain through to the def, or the documented above-the-statement form is
+    silently ineffective exactly where the real suppressions live."""
+    from orion_tpu.analysis import run_lint
+
+    template = (
+        "def _retrying(op, mode=None):\n"
+        "    def decorate(fn):\n"
+        "        return fn\n"
+        "    return decorate\n"
+        "class DocumentStorage:\n"
+        "    pass\n"
+        "class S(DocumentStorage):\n"
+        "{above}"
+        "    @_retrying(\"implicit\")\n"
+        "    def implicit_mode(self):\n"
+        "        return self._db.read(\"stuff\")\n"
+    )
+    bare = tmp_path / "bare.py"
+    bare.write_text(template.format(above=""))
+    assert {d.rule_id for d in run_lint([str(bare)])} == {"STO002"}  # premise
+
+    suppressed = tmp_path / "suppressed.py"
+    suppressed.write_text(
+        template.format(
+            above="    # lint: disable=STO002 -- test: mode argued elsewhere\n"
+        )
+    )
+    assert run_lint([str(suppressed)]) == []
+
+
+def test_wildcard_suppression_is_rejected_and_not_honored(tmp_path):
+    """`disable=*` would mute every current and future rule with one
+    reason — the engine reports it as LNT001 and keeps the named rules
+    firing."""
+    from orion_tpu.analysis import run_lint
+
+    wild = tmp_path / "wild.py"
+    wild.write_text(
+        "TELEMETRY = None\n"
+        "def h(items):\n"
+        "    for i in items:\n"
+        "        TELEMETRY.count(f\"k.{i}\")  # lint: disable=* -- legacy\n"
+    )
+    fired = {d.rule_id for d in run_lint([str(wild)])}
+    assert "LNT001" in fired and "TEL001" in fired
+
+
+def test_tel003_sentinel_requires_exclusively_enabled_writes(tmp_path):
+    """A variable assigned in an enabled-only branch is NOT a telemetry
+    sentinel if another write can leave it truthy with telemetry off —
+    otherwise an unguarded allocating call passes the self-lint."""
+    from orion_tpu.analysis import run_lint
+
+    registry = (
+        "class _R:\n"
+        "    enabled = False\n"
+        "    def record_span(self, name, start=None, args=None):\n"
+        "        pass\n"
+        "TELEMETRY = _R()\n"
+    )
+    leaky = tmp_path / "leaky.py"
+    leaky.write_text(
+        registry
+        + "def f(op):\n"
+        "    done = False\n"
+        "    if TELEMETRY.enabled:\n"
+        "        done = True\n"
+        "    done = True\n"
+        "    if done:\n"
+        "        TELEMETRY.record_span(f\"x.{op}\", args={\"op\": op})\n"
+    )
+    assert {d.rule_id for d in run_lint([str(leaky)])} == {"TEL003"}
+
+    honest = tmp_path / "honest.py"
+    honest.write_text(
+        registry
+        + "def g(n, clock):\n"
+        "    t0 = None\n"
+        "    if TELEMETRY.enabled:\n"
+        "        t0 = clock()\n"
+        "    if t0 is not None:\n"
+        "        TELEMETRY.record_span(\"step\", start=t0, args={\"n\": n})\n"
+    )
+    assert run_lint([str(honest)]) == []
+
+
+def test_tel003_sentinel_side_and_mint_polarity(tmp_path):
+    """The disabled side of a sentinel test must NOT whitelist an
+    allocating call, and a mint that is truthy with telemetry OFF is no
+    sentinel at all — while the equivalent honest inverted mint is."""
+    from orion_tpu.analysis import run_lint
+
+    registry = (
+        "class _R:\n"
+        "    enabled = False\n"
+        "    def record_span(self, name, start=None, args=None):\n"
+        "        pass\n"
+        "TELEMETRY = _R()\n"
+    )
+
+    disabled_side = tmp_path / "disabled_side.py"
+    disabled_side.write_text(
+        registry
+        + "def f(n, clock):\n"
+        "    t0 = clock() if TELEMETRY.enabled else None\n"
+        "    if t0 is None:\n"
+        "        TELEMETRY.record_span(\"step\", args={\"n\": n})\n"
+    )
+    assert {d.rule_id for d in run_lint([str(disabled_side)])} == {"TEL003"}
+
+    inverted_mint = tmp_path / "inverted_mint.py"
+    inverted_mint.write_text(
+        registry
+        + "def f(op, clock):\n"
+        "    t0 = clock() if not TELEMETRY.enabled else None\n"
+        "    if t0:\n"
+        "        TELEMETRY.record_span(f\"x.{op}\", args={\"op\": op})\n"
+    )
+    assert {d.rule_id for d in run_lint([str(inverted_mint)])} == {"TEL003"}
+
+    honest_inverted = tmp_path / "honest_inverted.py"
+    honest_inverted.write_text(
+        registry
+        + "def f(n, clock):\n"
+        "    t0 = None if not TELEMETRY.enabled else clock()\n"
+        "    if t0 is not None:\n"
+        "        TELEMETRY.record_span(\"step\", start=t0, args={\"n\": n})\n"
+    )
+    assert run_lint([str(honest_inverted)]) == []
+
+
+def test_jit003_separates_methods_from_module_functions(tmp_path):
+    """An attribute call resolves only against jitted METHODS (with the
+    implicit self shifting positions by one); a non-jit method sharing a
+    module-level jit function's name must not be misattributed."""
+    from orion_tpu.analysis import run_lint
+
+    shadow = tmp_path / "shadow.py"
+    shadow.write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def step(x, n):\n"
+        "    return x\n"
+        "class Algo:\n"
+        "    def step(self, x):\n"
+        "        return x\n"
+        "def drive(algo):\n"
+        "    return algo.step(2.5)\n"
+    )
+    assert run_lint([str(shadow)]) == []
+
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def step(x, n):\n"
+        "    return x\n"
+        "def drive():\n"
+        "    return step(2.5, 3)\n"
+    )
+    assert [(d.rule_id, d.line) for d in run_lint([str(bare)])] == [("JIT003", 7)]
+
+    # A genuinely jitted method: the bound call's args shift by the self
+    # slot, so a scalar landing in a static position stays quiet and one
+    # in a traced position fires.
+    method = tmp_path / "method.py"
+    method.write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "class Algo:\n"
+        "    @partial(jax.jit, static_argnums=(2,))\n"
+        "    def step(self, x, n):\n"
+        "        return x\n"
+        "def drive(algo):\n"
+        "    algo.step(1.0, 3)\n"
+        "    return algo.step(2.5, 3)\n"
+    )
+    findings = [(d.rule_id, d.line) for d in run_lint([str(method)])]
+    assert ("JIT003", 8) in findings and ("JIT003", 9) in findings
+
+
+def test_jit_collection_survives_name_shadowing(tmp_path):
+    """A jitted def sharing its name with a plain def elsewhere in the
+    module must still have its body checked (collection is per-node, not
+    first-def-wins by name), and the wrapper form binds to the LAST
+    module-level def like Python's own shadowing does."""
+    from orion_tpu.analysis import run_lint
+
+    shadowed = tmp_path / "shadowed.py"
+    shadowed.write_text(
+        "import jax\n"
+        "def step(x):\n"
+        "    return x\n"
+        "class A:\n"
+        "    @jax.jit\n"
+        "    def step(self, x):\n"
+        "        return x.item()\n"
+    )
+    assert [(d.rule_id, d.line) for d in run_lint([str(shadowed)])] == [
+        ("JIT001", 7)
+    ]
+
+    wrapper = tmp_path / "wrapper.py"
+    wrapper.write_text(
+        "import jax\n"
+        "class A:\n"
+        "    def f(self, x):\n"
+        "        return x\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+        "g = jax.jit(f)\n"
+    )
+    assert [(d.rule_id, d.line) for d in run_lint([str(wrapper)])] == [
+        ("JIT001", 6)
+    ]
+
+
+def test_cli_exit_2_only_for_argument_level_bad_paths(tmp_path):
+    """LNT003 on the ARGUMENT means a usage error (exit 2); LNT003 on a
+    file merely discovered under a valid directory argument is a lint
+    finding like any other (exit 1)."""
+    import contextlib
+    import io
+
+    from orion_tpu.cli import main
+
+    def run(*argv):
+        with contextlib.redirect_stdout(io.StringIO()):
+            with contextlib.redirect_stderr(io.StringIO()):
+                return main(["lint", *argv])
+
+    assert run(str(tmp_path / "missing.py")) == 2
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "ok.py").write_text("x = 1\n")
+    unreadable = pkg / "locked.py"
+    unreadable.write_text("y = 2\n")
+    unreadable.chmod(0)
+    if os.access(str(unreadable), os.R_OK):  # root: chmod 0 is a no-op
+        pytest.skip("cannot make a file unreadable as this user")
+    try:
+        assert run(str(pkg)) == 1
+    finally:
+        unreadable.chmod(0o644)
+
+
+def test_jit_collection_sees_self_attribute_wrappers(tmp_path):
+    """`self._g = jax.jit(self._impl)` (the space.py decode-path idiom)
+    must register _impl as jit-compiled so JIT001/002 check its body."""
+    from orion_tpu.analysis import run_lint
+
+    src = tmp_path / "selfwrap.py"
+    src.write_text(
+        "import jax\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self._decode_jit = jax.jit(self._impl)\n"
+        "    def _impl(self, x):\n"
+        "        return x.item()\n"
+    )
+    assert [(d.rule_id, d.line) for d in run_lint([str(src)])] == [("JIT001", 6)]
+
+
+def test_jit_rules_exempt_static_array_metadata(tmp_path):
+    """x.shape / x.ndim / x.dtype are concrete under tracing: branching or
+    float()-ing them is trace-safe and must not fire, while reads of the
+    traced value itself still do."""
+    from orion_tpu.analysis import run_lint
+
+    safe = tmp_path / "safe.py"
+    safe.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, y):\n"
+        "    assert x.shape[0] == y.shape[0]\n"
+        "    if x.ndim > 1:\n"
+        "        return x * float(x.shape[0])\n"
+        "    return x\n"
+    )
+    assert run_lint([str(safe)]) == []
+
+    unsafe = tmp_path / "unsafe.py"
+    unsafe.write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return float(x)\n"
+        "    return x\n"
+    )
+    fired = [(d.rule_id, d.line) for d in run_lint([str(unsafe)])]
+    assert ("JIT002", 4) in fired and ("JIT001", 5) in fired
+
+
+def test_jit003_checks_imported_module_call_sites(tmp_path):
+    """`import mod_a` + `mod_a.step(2.5, ...)` is the common cross-module
+    host call form — the attribute base being a module alias means no
+    self slot, and the module-level registration applies."""
+    from orion_tpu.analysis import run_lint
+
+    (tmp_path / "mod_a.py").write_text(
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, static_argnums=(1,))\n"
+        "def step(x, n):\n"
+        "    return x\n"
+    )
+    (tmp_path / "mod_b.py").write_text(
+        "import mod_a\n"
+        "def drive():\n"
+        "    return mod_a.step(2.5, 3)\n"
+    )
+    findings = [
+        (d.rule_id, os.path.basename(d.path), d.line)
+        for d in run_lint([str(tmp_path)])
+    ]
+    assert findings == [("JIT003", "mod_b.py", 3)]
+
+
+def test_prose_mentioning_suppression_syntax_does_not_suppress(tmp_path):
+    """The directive must START the comment: prose that mentions
+    `lint: disable=` mid-sentence mints nothing."""
+    from orion_tpu.analysis import run_lint
+
+    src = tmp_path / "prose.py"
+    src.write_text(
+        "TELEMETRY = None\n"
+        "def f(items):\n"
+        "    for i in items:\n"
+        "        # to silence this, use lint: disable=TEL001 -- see docs\n"
+        "        TELEMETRY.count(f\"k.{i}\")\n"
+    )
+    fired = {d.rule_id for d in run_lint([str(src)])}
+    assert "TEL001" in fired and "LNT001" not in fired
+
+
+def test_unmatched_select_prefix_is_loud(tmp_path):
+    """`--select ST0` (zero for O) matching no rule id must error, not
+    lint nothing and report clean."""
+    from orion_tpu.analysis import run_lint
+
+    src = tmp_path / "x.py"
+    src.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="ST0"):
+        run_lint([str(src)], select=["ST0"])
+    with pytest.raises(ValueError, match="TEL9"):
+        run_lint([str(src)], ignore=["TEL9"])
+
+
+def test_jit003_wrapper_binding_is_the_call_site_name(tmp_path):
+    """`fast = jax.jit(slow)`: host calls reach the jit cache through
+    `fast` — flag those; a direct `slow(...)` call runs eagerly and must
+    stay quiet."""
+    from orion_tpu.analysis import run_lint
+
+    src = tmp_path / "wrap.py"
+    src.write_text(
+        "import jax\n"
+        "def slow(x, n):\n"
+        "    return x\n"
+        "fast = jax.jit(slow, static_argnums=(1,))\n"
+        "def drive():\n"
+        "    slow(1.0, 3)\n"
+        "    return fast(2.5, 3)\n"
+    )
+    assert [(d.rule_id, d.line) for d in run_lint([str(src)])] == [("JIT003", 7)]
+
+
+def test_lck001_sees_context_managed_callee_under_lock(tmp_path):
+    """A callee entered as a with-item while a lock is held acquires its
+    locks under that hold, same as the plain-call form — 'with lock: with
+    RING.span():' is the project's own nesting idiom and must keep
+    feeding the lock graph."""
+    from orion_tpu.analysis import run_lint
+
+    src = tmp_path / "ctx.py"
+    src.write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Ring:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def span(self):\n"
+        "        with self._lock:\n"
+        "            return object()\n"
+        "\n"
+        "    def flush(self):\n"
+        "        with self._lock:\n"
+        "            DRV.commit()\n"
+        "\n"
+        "\n"
+        "class Driver:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "\n"
+        "    def commit(self):\n"
+        "        with self._lock:\n"
+        "            pass\n"
+        "\n"
+        "    def exchange(self):\n"
+        "        with self._lock:\n"
+        "            with RING.span():\n"
+        "                pass\n"
+        "\n"
+        "\n"
+        "RING = Ring()\n"
+        "DRV = Driver()\n"
+    )
+    # Ring._lock -> Driver._lock comes from the plain call in flush();
+    # Driver._lock -> Ring._lock ONLY from the with-item in exchange().
+    assert "LCK001" in {d.rule_id for d in run_lint([str(src)])}
