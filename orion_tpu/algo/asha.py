@@ -162,6 +162,50 @@ class ASHA(BaseAlgorithm):
         # subset of bracket 0's), so assignment is tracked at suggest time.
         self._bracket_of = {}
 
+    # --- health --------------------------------------------------------------
+    def rung_occupancy(self):
+        """Per-bracket rung fill: ``[[(resources, occupied, evaluated),
+        ...], ...]`` — ``occupied`` counts every slot (pending promotions
+        included), ``evaluated`` only slots holding a real objective.  The
+        optimization-health signal for fidelity schedulers: a rung whose
+        occupancy stalls is where the ladder is starved."""
+        # Lists, not tuples: these land verbatim in storage documents, and
+        # the JSON-codec backends round-trip lists only.
+        return [
+            [
+                [
+                    rung["resources"],
+                    len(rung["results"]),
+                    sum(
+                        1
+                        for entry in rung["results"].values()
+                        if entry[0] is not None
+                    ),
+                ]
+                for rung in bracket.rungs
+            ]
+            for bracket in self.brackets
+        ]
+
+    def health_record(self):
+        """Host-side health snapshot (orion_tpu.health): rung occupancy +
+        the best evaluated objective across rungs.  GP-backed subclasses
+        (asha_bo) extend this with the device GP/acquisition fields."""
+        best = None
+        for bracket in self.brackets:
+            for rung in bracket.rungs:
+                for objective, _params in rung["results"].values():
+                    if objective is not None and (best is None or objective < best):
+                        best = objective
+        record = {
+            "algo": type(self).__name__.lower(),
+            "n_obs": int(self._n_observed),
+            "rung_occupancy": self.rung_occupancy(),
+        }
+        if best is not None:
+            record["best_y"] = float(best)
+        return record
+
     # --- identity ------------------------------------------------------------
     def _point_hash(self, params):
         """md5 over non-fidelity params (reference `asha.py:204-210`).

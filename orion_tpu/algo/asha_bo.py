@@ -359,6 +359,28 @@ class ASHABO(ASHA):
         self._gp_state = state
         return rows
 
+    # --- health --------------------------------------------------------------
+    def health_record(self):
+        """ASHA's rung occupancy plus the GP side (orion_tpu.health):
+        incumbent over the augmented history, trust-region box, and the
+        device GP/acquisition fields the last fused step attached to its
+        GPState (ready data — no device sync)."""
+        from orion_tpu.health import unpack_device_health
+
+        record = super().health_record()
+        record.update(
+            tr_length=float(self._tr_length),
+            tr_succ=int(self._tr_succ),
+            tr_fail=int(self._tr_fail),
+        )
+        if self._host.count:
+            record["best_y"] = float(self._host.best_y)
+            record["n_obs"] = int(self._host.count)
+        state = self._gp_state
+        if state is not None and state.health is not None:
+            record.update(unpack_device_health(state.health))
+        return record
+
     # --- state ---------------------------------------------------------------
     def state_dict(self):
         out = super().state_dict()

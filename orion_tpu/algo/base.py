@@ -312,6 +312,21 @@ class BaseAlgorithm:
         rounds — the *naive* copy that produced the suggestion is discarded
         every round."""
 
+    def health_record(self):
+        """One optimization-health snapshot dict, or None when the
+        algorithm has nothing to report (the default).
+
+        Contract (orion_tpu.health): host-side truth only from the
+        instance itself (incumbent value, observation count, trust-region
+        box, rung occupancy), device-side GP/acquisition fields unpacked
+        from the last fused step's packed health vector — reading it must
+        never force a device sync beyond transferring already-computed
+        values.  The producer merges the real instance's host fields over
+        the naive copy's device fields (the copy is the one that actually
+        suggested, but its host history contains fantasy lies) and flushes
+        one record per round through ``storage.record_health``."""
+        return None
+
     @property
     def n_observed(self):
         return self._n_observed

@@ -153,6 +153,27 @@ class BOHB(Hyperband):
             return good
         return np.concatenate(boost + [good])
 
+    # --- health -------------------------------------------------------------
+    def health_record(self):
+        """Hyperband's rung occupancy plus the KDE side (orion_tpu.health):
+        per-budget-tier observation counts, the tier currently modeled (or
+        None while still random-sampling), and the incumbent over every
+        tier."""
+        record = super().health_record()
+        tier = self._model_tier()
+        record["model_tier"] = int(tier) if tier is not None else None
+        record["tier_counts"] = {
+            str(t): int(self._tier_y[t].shape[0]) for t in sorted(self._tier_y)
+        }
+        best = None
+        for ys in self._tier_y.values():
+            if ys.shape[0]:
+                tier_best = float(np.min(ys))
+                best = tier_best if best is None else min(best, tier_best)
+        if best is not None:
+            record["best_y"] = best
+        return record
+
     # --- state --------------------------------------------------------------
     def state_dict(self):
         out = super().state_dict()

@@ -14,7 +14,7 @@ Design notes (TPU-first):
 """
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,12 @@ class GPState(NamedTuple):
     alpha: jnp.ndarray  # (n_pad,) chol^-T chol^-1 y_norm
     y_mean: jnp.ndarray  # ()
     y_std: jnp.ndarray  # ()
+    # Optimization-health extras (orion_tpu.health): () marginal
+    # log-likelihood per observation of the final fit, and the packed
+    # per-round DEVICE_HEALTH_FIELDS vector the fused suggest step attaches
+    # via _replace.  Optional (None) so ad-hoc constructions stay valid.
+    mll: Optional[jnp.ndarray] = None  # ()
+    health: Optional[jnp.ndarray] = None  # (len(DEVICE_HEALTH_FIELDS),)
 
 
 def init_hypers(n_dims):
@@ -129,9 +135,16 @@ def fit_gp(x, y, mask, kind="matern52", n_steps=50, lr=0.08, init=None,
     k = _masked_kernel(kind, x, mask, hypers)
     chol = jnp.linalg.cholesky(k)
     alpha = jax.scipy.linalg.cho_solve((chol, True), y_norm)
+    # Fit health for free: the final factorization already yields the
+    # marginal likelihood terms (quad form + logdet) — a couple of vector
+    # reductions, no extra Cholesky (orion_tpu.health, `gp_mll`).
+    n = jnp.maximum(jnp.sum(mask), 1.0)
+    quad = jnp.dot(y_norm, alpha)
+    logdet = 2.0 * jnp.sum(jnp.log(jnp.diagonal(chol)) * mask)
+    mll = -0.5 * (quad + logdet) / n
     return GPState(
         x=x, y=y, mask=mask, hypers=hypers, chol=chol, alpha=alpha,
-        y_mean=y_mean, y_std=y_std,
+        y_mean=y_mean, y_std=y_std, mll=mll,
     )
 
 

@@ -27,6 +27,7 @@ import logging
 import threading
 import time
 
+from orion_tpu.health import FLIGHT
 from orion_tpu.telemetry import TELEMETRY
 
 log = logging.getLogger(__name__)
@@ -165,6 +166,11 @@ class BucketPrewarmer:
                 self._completed += 1
         TELEMETRY.count("jax.prewarms")
         TELEMETRY.record_span("jax.prewarm.compile", start=t0)
+        # Flight event (orion_tpu.health): a background compile landing on
+        # the timeline explains bucket crossings in a post-mortem.  Guarded
+        # — the args dict must not allocate when the recorder is off.
+        if FLIGHT.enabled:
+            FLIGHT.record("jax.prewarm", args={"key": str(key)})
 
     def completed_count(self):
         """Prewarm attempts THIS instance finished (success or failure) —

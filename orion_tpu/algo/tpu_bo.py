@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orion_tpu.health import FLIGHT
 from orion_tpu.telemetry import TELEMETRY
 
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
@@ -442,6 +443,29 @@ class TPUBO(BaseAlgorithm):
         )
         self._gp_state = state
         return rows
+
+    # --- health -------------------------------------------------------------
+    def health_record(self):
+        """Per-round optimization health (orion_tpu.health): incumbent +
+        trust-region box from the host trackers (all O(1) reads), GP fit /
+        acquisition / dedup fields unpacked from the packed device vector
+        the last fused step attached to its GPState (already computed —
+        reading it transfers ready data, it does not sync the device)."""
+        from orion_tpu.health import unpack_device_health
+
+        record = {
+            "algo": type(self).__name__.lower(),
+            "n_obs": int(self._host.count),
+            "tr_length": float(self._tr_length),
+            "tr_succ": int(self._tr_succ),
+            "tr_fail": int(self._tr_fail),
+        }
+        if self._host.count:
+            record["best_y"] = float(self._host.best_y)
+        state = self._gp_state
+        if state is not None and state.health is not None:
+            record.update(unpack_device_health(state.health))
+        return record
 
     # --- state --------------------------------------------------------------
     def state_dict(self):
@@ -984,6 +1008,14 @@ def run_suggest_step_arrays(
         )
         if retraced:
             TELEMETRY.count("jax.retraces")
+            # A synchronous retrace is exactly the kind of stall a crash
+            # post-mortem wants on its timeline — book it in the flight
+            # ring too (guarded: the args dict must not allocate when the
+            # recorder is off).
+            if FLIGHT.enabled:
+                FLIGHT.record(
+                    "jax.retrace", args={"q": int(num), "n": int(x.shape[0])}
+                )
     # Dedup ordered unique draws first, so the first `num` rows are the ones
     # the un-padded call would have returned.  Rows come back as a DEVICE
     # array slice: jax dispatch is asynchronous, so callers that defer the
@@ -1165,9 +1197,8 @@ def _suggest_step(
             k_acq, state, candidates, q, kind=kernel, acq=acq, best=best, beta=beta
         )
     mean, std = posterior_norm(state, candidates, kind=kernel)
-    ei_rank = select_q(
-        expected_improvement(mean, std, best), min(4 * q, n_candidates)
-    )
+    ei = expected_improvement(mean, std, best)
+    ei_rank = select_q(ei, min(4 * q, n_candidates))
     if trust_region:
         # Guarantee one pure-exploitation member per batch: the pool's
         # posterior-mean minimizer (usually a gradient-polished point).
@@ -1185,5 +1216,26 @@ def _suggest_step(
         injected = jnp.where(already_observed, idx[0], exploit_idx)
         idx = jnp.concatenate([injected[None], idx])[:q]
     final_idx = _dedup_fill_device(idx, ei_rank, q)
+    # Packed per-round health vector (health.DEVICE_HEALTH_FIELDS), built
+    # entirely from intermediates this step already computed — a handful of
+    # reductions, attached to the returned state so no signature changes
+    # and no extra device->host syncs (the vector is read lazily after the
+    # q-row transfer already materialized the round).
+    ls = jnp.exp(state.hypers.log_lengthscales[:d_free])
+    sorted_idx = jnp.sort(final_idx)
+    n_unique = 1.0 + jnp.sum((sorted_idx[1:] != sorted_idx[:-1]).astype(ls.dtype))
+    health = jnp.stack(
+        [
+            state.mll,
+            jnp.min(ls),
+            jnp.mean(ls),
+            jnp.max(ls),
+            jnp.exp(state.hypers.log_noise),
+            jnp.max(ei),
+            jnp.mean(ei),
+            n_unique / q,
+        ]
+    ).astype(jnp.float32)
+    state = state._replace(health=health)
     return jnp.take(free_candidates, final_idx, axis=0), state
 

@@ -1,4 +1,4 @@
-"""Telemetry discipline rules (``TEL001``–``TEL003``).
+"""Telemetry discipline rules (``TEL001``–``TEL004``).
 
 PR 3's contract: the registry is near-zero-cost when disabled, and stays
 cheap when enabled.  Three ways code quietly breaks it — computing a
@@ -6,7 +6,13 @@ registry key per loop iteration, timing a block with a manually-managed
 span (leaks the record on an exception path), and building f-string names
 or args dicts at a call site that runs even when telemetry is off (the
 mutator early-returns, but its arguments were already allocated).
-"""
+
+``TEL004`` extends the allocation discipline to the optimization-health
+emitters (PR 7, ``orion_tpu.health``): ``FLIGHT.record(...)`` and the
+storage channel's ``record_health(...)`` sit on the same hot paths as the
+TELEMETRY mutators and must not build allocating arguments on the
+disabled path either (a guard on ``FLIGHT.enabled`` or
+``TELEMETRY.enabled`` whitelists, exactly as for TEL003)."""
 
 import ast
 
@@ -61,7 +67,15 @@ def _enabled_polarity(test, negated=False):
     if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
         return _enabled_polarity(test.operand, not negated)
     name = dotted_name(test)
-    if name and name.split(".")[-1] == "enabled" and "TELEMETRY" in name:
+    if (
+        name
+        and name.split(".")[-1] == "enabled"
+        and ("TELEMETRY" in name or "FLIGHT" in name)
+    ):
+        # Both observability flags dominate: TELEMETRY.enabled for the
+        # registry mutators, FLIGHT.enabled for the health/flight
+        # emitters (TEL004) — the CLI flips them together, and either
+        # guard proves the disabled path skips the allocation.
         return "neg" if negated else "pos"
     if isinstance(test, ast.BoolOp):
         results = [_enabled_polarity(v, negated) for v in test.values]
@@ -419,4 +433,71 @@ class AllocationOnDisabledPath(Rule):
             )
 
 
-TELEMETRY_RULES = (DynamicKeyInLoop, UnmanagedSpan, AllocationOnDisabledPath)
+def _health_call(node):
+    """The emitter label when ``node`` is an optimization-health emission
+    call — ``FLIGHT.record(...)`` (any qualification) or a storage
+    ``record_health(...)`` — else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    if len(parts) >= 2 and parts[-2] == "FLIGHT" and parts[-1] == "record":
+        return "FLIGHT.record"
+    if parts[-1] == "record_health":
+        return "record_health"
+    return None
+
+
+class HealthEmissionOnDisabledPath(Rule):
+    id = "TEL004"
+    name = "health-emission-on-disabled-path"
+    description = (
+        "No allocation-bearing health/flight-record emissions on the "
+        "disabled fast path: FLIGHT.record(...) and storage "
+        "record_health(...) calls whose arguments build f-strings/dicts/"
+        "lists allocate even when the recorder is off — guard the call "
+        "site with FLIGHT.enabled (or TELEMETRY.enabled), same discipline "
+        "as TEL003."
+    )
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            emitter = _health_call(node)
+            if emitter is None:
+                continue
+            allocating = None
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, _ALLOCATING_NODES):
+                        allocating = sub
+                        break
+                if allocating is not None:
+                    break
+            if allocating is None:
+                continue
+            if _is_guarded(node):
+                continue
+            kind = (
+                "f-string"
+                if isinstance(allocating, ast.JoinedStr)
+                else type(allocating).__name__.lower()
+            )
+            yield Diagnostic(
+                module.path,
+                node.lineno,
+                node.col_offset,
+                self.id,
+                f"{emitter}() builds a {kind} argument on an unguarded "
+                "path — it allocates even with the flight recorder "
+                "disabled; wrap the call in 'if FLIGHT.enabled:'",
+            )
+
+
+TELEMETRY_RULES = (
+    DynamicKeyInLoop,
+    UnmanagedSpan,
+    AllocationOnDisabledPath,
+    HealthEmissionOnDisabledPath,
+)

@@ -30,8 +30,57 @@ def add_subparser(subparsers):
         action="store_true",
         help="audit every experiment in the storage, not just -n NAME",
     )
+    parser.add_argument(
+        "--flight-out",
+        default=None,
+        metavar="path",
+        help="where a failed audit dumps its flight-record artifact "
+        "(default: flight-audit-<experiment>.jsonl)",
+    )
     parser.set_defaults(func=main)
     return parser
+
+
+def _dump_failure(report, out=None, suffix=False):
+    """A failed audit leaves a flight-record JSONL artifact: the recent
+    event ring (when this process recorded any) plus every violation as a
+    structured event — the post-mortem starts from the artifact, not from
+    scrollback.  Violations ride ``extra_events`` so this cold path needs
+    no guarded hot-path ``record`` calls.
+
+    Only dumps when the operator asked for observability: the flight
+    recorder is enabled, or ``--flight-out`` names a path explicitly — a
+    cron audit that never opted in must not scatter artifacts into its
+    cwd (same rule as ``FlightRecorder.dump_crash``).  ``suffix=True``
+    (the ``--all`` sweep with an explicit path) keys the file by
+    experiment so multiple failing experiments don't overwrite each
+    other's dumps."""
+    import os
+    import time
+
+    from orion_tpu.health import FLIGHT
+
+    if out is None and not FLIGHT.enabled:
+        print(
+            "audit failed; pass --flight-out PATH (or enable the flight "
+            "recorder) to dump a flight-record artifact"
+        )
+        return None
+    events = [
+        {
+            "kind": "audit.violation",
+            "ts": time.time(),
+            "args": dict(violation),
+        }
+        for violation in report.violations
+    ]
+    path = out or f"flight-audit-{report.experiment_id}.jsonl"
+    if suffix and out is not None:
+        root, ext = os.path.splitext(out)
+        path = f"{root}-{report.experiment_id}{ext or '.jsonl'}"
+    FLIGHT.dump(path, reason="audit-failure", extra_events=events)
+    print(f"audit failed; flight record written to {path}")
+    return path
 
 
 def main(args):
@@ -59,6 +108,15 @@ def main(args):
             return 0
         for report in reports:
             print(report.summary())
+        failed = [r for r in reports if not r.ok]
+        for report in failed:
+            # Per-experiment suffixing when several fail: one shared
+            # --flight-out path must not have each dump overwrite the last.
+            _dump_failure(
+                report,
+                getattr(args, "flight_out", None),
+                suffix=len(failed) > 1,
+            )
         return 0 if all(r.ok for r in reports) else 1
 
     experiment, _parser = build_from_args(
@@ -68,4 +126,6 @@ def main(args):
         experiment.storage, experiment, lost_timeout=args.timeout
     )
     print(report.summary())
+    if not report.ok:
+        _dump_failure(report, getattr(args, "flight_out", None))
     return 0 if report.ok else 1

@@ -103,15 +103,20 @@ def load_cli_config(args):
             "path": args.storage_path,
         }
     config = resolve_config(file_config, cmd_config, storage_override)
-    # `telemetry:` in any config layer flips the process-wide registry; a
-    # None (unset) leaves whatever ORION_TPU_TELEMETRY decided at import.
+    # `telemetry:` in any config layer flips the process-wide registry AND
+    # the flight recorder (one switch for the whole observability layer); a
+    # None (unset) leaves whatever ORION_TPU_TELEMETRY / ORION_TPU_FLIGHT
+    # decided at import.
     if config.get("telemetry") is not None:
+        from orion_tpu.health import FLIGHT
         from orion_tpu.telemetry import TELEMETRY
 
         if config["telemetry"]:
             TELEMETRY.enable()
+            FLIGHT.enable()
         else:
             TELEMETRY.disable()
+            FLIGHT.disable()
     return config
 
 

@@ -13,6 +13,13 @@ from orion_tpu.cli.base import add_experiment_args, build_from_args
 def add_subparser(subparsers):
     parser = subparsers.add_parser("info", help="show experiment details")
     add_experiment_args(parser, with_user_args=False)
+    parser.add_argument(
+        "--per-worker",
+        action="store_true",
+        help="show each worker's telemetry/health snapshot separately "
+        "instead of only the merged view (MAX-merged gauges hide WHICH "
+        "worker is lagging)",
+    )
     parser.set_defaults(func=main)
     return parser
 
@@ -21,7 +28,7 @@ def _section(title):
     return f"\n{title}\n{'=' * len(title)}"
 
 
-def format_info(experiment):
+def format_info(experiment, per_worker=False):
     out = [_section("Commandline")]
     out.append(" ".join(experiment.metadata.get("user_args", [])) or "(none)")
 
@@ -70,10 +77,15 @@ def format_info(experiment):
         out.append(_section("Performance"))
         out.extend(perf)
 
-    tele = _telemetry_section(experiment)
+    tele = _telemetry_section(experiment, per_worker=per_worker)
     if tele:
         out.append(_section("Telemetry"))
         out.extend(tele)
+
+    health = _health_section(experiment, per_worker=per_worker)
+    if health:
+        out.append(_section("Health"))
+        out.extend(health)
     return "\n".join(out) + "\n"
 
 
@@ -106,38 +118,115 @@ def _perf_section(experiment):
     return lines
 
 
-def _telemetry_section(experiment):
+def _snapshot_lines(snapshot):
+    """Histogram/counter/gauge lines for one (merged or per-worker)
+    metrics snapshot dict."""
+    from orion_tpu.telemetry import histogram_percentile
+
+    lines = []
+    for name, hist in sorted((snapshot.get("histograms") or {}).items()):
+        if not hist.get("count"):
+            continue
+        p50, p90, p99 = (
+            histogram_percentile(hist, p) * 1e3 for p in (50, 90, 99)
+        )
+        lines.append(
+            f"{name}: {hist['count']} samples | "
+            f"p50 {p50:.1f}ms  p90 {p90:.1f}ms  p99 {p99:.1f}ms  "
+            f"max {hist.get('max', 0.0) * 1e3:.1f}ms"
+        )
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        lines.append(f"{name}: {value}")
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        lines.append(f"{name}: {float(value):.4g}")
+    return lines
+
+
+def _telemetry_section(experiment, per_worker=False):
     """The unified-telemetry block: per-op latency percentiles from the
     merged cross-worker histogram snapshots (orion_tpu.telemetry), plus
     the counters (jax retraces, storage transactions/wire requests/
     reconnects, lost-trial sweeps) and gauges each worker flushed through
     the storage metrics channel.  Empty unless a hunt ran with
-    ``ORION_TPU_TELEMETRY=1`` (or ``telemetry: true``).  The WHOLE section
-    is guarded, not just the fetch: a malformed doc (third-party backend,
-    corruption) must drop this block, never take down ``info``."""
-    from orion_tpu.telemetry import histogram_percentile, merge_snapshots
+    ``ORION_TPU_TELEMETRY=1`` (or ``telemetry: true``).  ``per_worker``
+    keeps each worker's snapshot separate instead of merging — the merged
+    view's MAX-combined gauges say only that SOME worker lags, never which
+    one.  The WHOLE section is guarded, not just the fetch: a malformed
+    doc (third-party backend, corruption) must drop this block, never
+    take down ``info``."""
+    from orion_tpu.telemetry import merge_snapshots
 
     try:
         docs = experiment.storage.fetch_metrics(experiment)
         if not docs:
             return []
+        if per_worker:
+            lines = [f"workers reporting: {len(docs)}"]
+            for doc in docs:
+                lines.append(f"--- worker {doc.get('worker') or '?'}")
+                lines.extend(_snapshot_lines(doc))
+            return lines
         merged = merge_snapshots(docs)
-        lines = [f"workers reporting: {len(docs)}"]
-        for name, hist in sorted(merged["histograms"].items()):
-            if not hist.get("count"):
-                continue
-            p50, p90, p99 = (
-                histogram_percentile(hist, p) * 1e3 for p in (50, 90, 99)
-            )
-            lines.append(
-                f"{name}: {hist['count']} samples | "
-                f"p50 {p50:.1f}ms  p90 {p90:.1f}ms  p99 {p99:.1f}ms  "
-                f"max {hist.get('max', 0.0) * 1e3:.1f}ms"
-            )
-        for name, value in sorted(merged["counters"].items()):
-            lines.append(f"{name}: {value}")
-        for name, value in sorted(merged["gauges"].items()):
-            lines.append(f"{name}: {value:.4g}")
+        return [f"workers reporting: {len(docs)}"] + _snapshot_lines(merged)
+    except Exception:
+        return []
+
+
+def _health_section(experiment, per_worker=False):
+    """The optimization-health block (orion_tpu.health): the fleet-wide
+    incumbent over the recorded regret trajectory and, per worker, the
+    latest per-round health record — GP marginal likelihood, lengthscale
+    spread, acquisition level, trust-region box, rung occupancy.  Guarded
+    like the telemetry block; empty when no hunt recorded health."""
+    try:
+        docs = experiment.storage.fetch_health(experiment)
+        if not docs:
+            return []
+        best = None
+        for doc in docs:
+            y = doc.get("best_y")
+            if y is not None and (best is None or y < best):
+                best = y
+        by_worker = {}
+        for doc in docs:  # time-ordered: the last doc per worker wins
+            by_worker[str(doc.get("worker") or "?")] = doc
+        lines = [f"health records: {len(docs)} from {len(by_worker)} worker(s)"]
+        if best is not None:
+            lines.append(f"incumbent best_y: {best:.6g}")
+        for worker, doc in sorted(by_worker.items()):
+            fields = []
+            for key, spec in (
+                ("round", "d"),
+                ("n_obs", "d"),
+                ("best_y", ".5g"),
+                ("gp_mll", ".3f"),
+                ("gp_ls_mean", ".3g"),
+                ("gp_noise", ".3g"),
+                ("acq_ei_max", ".3g"),
+                ("q_unique_frac", ".2f"),
+                ("tr_length", ".3f"),
+                ("model_tier", "d"),
+            ):
+                value = doc.get(key)
+                if value is not None:
+                    if spec == "d":
+                        value = int(value)
+                    fields.append(f"{key} {format(value, spec)}")
+            occupancy = doc.get("rung_occupancy")
+            if occupancy:
+                # Every bracket, not just the first: the starved rung the
+                # signal exists to expose can sit in any ladder.  Per rung:
+                # ``resources:occupied(evaluated)`` — occupied counts
+                # pending promotion slots too, evaluated only real
+                # objectives.
+                for index, bracket in enumerate(occupancy):
+                    rungs = " ".join(
+                        f"{resources}:{occupied}({evaluated})"
+                        for resources, occupied, evaluated in bracket
+                    )
+                    fields.append(f"rungs[b{index}] {rungs}")
+            header = f"{worker}: " if per_worker or len(by_worker) > 1 else ""
+            lines.append(header + "  ".join(fields))
         return lines
     except Exception:
         return []
@@ -147,5 +236,5 @@ def main(args):
     experiment, _parser = build_from_args(
         args, need_user_args=False, allow_create=False, view=True
     )
-    print(format_info(experiment))
+    print(format_info(experiment, per_worker=getattr(args, "per_worker", False)))
     return 0

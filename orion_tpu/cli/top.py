@@ -1,0 +1,263 @@
+"""`orion-tpu top`: live per-worker optimization-health dashboard.
+
+No reference counterpart — part of the TPU build's optimization-health
+subsystem (orion_tpu.health).  Polls the storage telemetry + health
+channels and renders, per worker: producer round rate, heartbeat lag,
+storage p99 latency, retries/reconnects, and the latest health record
+(incumbent, GP marginal likelihood, trust-region length); plus a merged
+regret-curve sparkline across the fleet.  ``--json`` is the one-shot
+scripting mode: print one JSON snapshot and exit.
+"""
+
+import json
+import sys
+import time
+
+from orion_tpu.cli.base import add_experiment_args, build_from_args
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser(
+        "top", help="live per-worker optimization-health dashboard"
+    )
+    add_experiment_args(parser, with_user_args=False)
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="print ONE machine-readable snapshot and exit (scripting mode)",
+    )
+    parser.add_argument(
+        "-i",
+        "--interval",
+        type=float,
+        default=2.0,
+        metavar="seconds",
+        help="refresh interval in live mode (default: 2s)",
+    )
+    parser.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        metavar="N",
+        help="render N frames then exit (default 0 = until interrupted)",
+    )
+    parser.set_defaults(func=main)
+    return parser
+
+
+def sparkline(values, width=40):
+    """Unicode sparkline of ``values`` downsampled to ``width`` columns."""
+    values = [float(v) for v in values if v is not None]
+    if not values:
+        return ""
+    if len(values) > width:
+        # Keep the last point exact (the current incumbent) and stride the
+        # rest — a regret curve's tail is the part being watched.
+        stride = len(values) / float(width)
+        values = [values[int(i * stride)] for i in range(width - 1)] + [values[-1]]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    return "".join(
+        SPARK_CHARS[int((v - lo) / span * (len(SPARK_CHARS) - 1))] for v in values
+    )
+
+
+def _merged_percentile(histograms, prefix, p):
+    """Worst p-th percentile (ms) over every histogram named under
+    ``prefix`` — the per-worker "storage p99" number."""
+    from orion_tpu.telemetry import histogram_percentile
+
+    worst = None
+    for name, hist in (histograms or {}).items():
+        if not name.startswith(prefix) or not hist.get("count"):
+            continue
+        value = histogram_percentile(hist, p) * 1e3
+        worst = value if worst is None else max(worst, value)
+    return worst
+
+
+def _counter_sum(counters, *needles):
+    """Sum every counter whose name contains one of ``needles`` (the
+    reconnects counter is per-backend-prefixed: ``storage.network
+    .reconnects``)."""
+    total = 0
+    found = False
+    for name, value in (counters or {}).items():
+        if any(needle in name for needle in needles):
+            total += int(value)
+            found = True
+    return total if found else None
+
+
+def snapshot_top(experiment, now=None):
+    """One dashboard snapshot dict from the storage channels.
+
+    ``workers`` merges the metrics-snapshot docs (rates, lags, p99s,
+    retries) with each worker's LATEST health record (incumbent, GP fit,
+    trust region); ``incumbent``/``regret_curve`` aggregate health records
+    across the fleet in time order.  Round rate is derived from each
+    worker's health-record timestamps (rounds per second over the window
+    the records span), so a one-shot ``--json`` call needs no second poll.
+    """
+    now = time.time() if now is None else now
+    storage = experiment.storage
+    metrics_docs = storage.fetch_metrics(experiment)
+    health_docs = storage.fetch_health(experiment)
+
+    workers = {}
+    for doc in metrics_docs:
+        worker = str(doc.get("worker") or "?")
+        counters = doc.get("counters") or {}
+        gauges = doc.get("gauges") or {}
+        histograms = doc.get("histograms") or {}
+        rounds_hist = histograms.get("producer.round") or {}
+        workers[worker] = {
+            "rounds": int(rounds_hist.get("count", 0)),
+            "round_rate": None,
+            "heartbeat_lag_s": gauges.get("pacemaker.heartbeat_lag_s"),
+            "storage_p99_ms": _merged_percentile(histograms, "storage.", 99),
+            "retries": int(counters.get("storage.retries", 0)),
+            "gave_up": int(counters.get("storage.gave_up", 0)),
+            "reconnects": _counter_sum(counters, ".reconnects") or 0,
+            "retraces": int(counters.get("jax.retraces", 0)),
+            "last_seen_s": round(now - float(doc.get("time") or now), 3),
+            "health": None,
+        }
+
+    by_worker = {}
+    for doc in health_docs:
+        by_worker.setdefault(str(doc.get("worker") or "?"), []).append(doc)
+    curve = []
+    best = None
+    best_doc = None
+    for doc in health_docs:  # already time-ordered
+        y = doc.get("best_y")
+        if y is None:
+            continue
+        best = y if best is None else min(best, y)
+        best_doc = doc if best == y else best_doc
+        curve.append(best)
+    for worker, docs in by_worker.items():
+        entry = workers.setdefault(
+            worker,
+            {
+                "rounds": len(docs),
+                "round_rate": None,
+                "heartbeat_lag_s": None,
+                "storage_p99_ms": None,
+                "retries": 0,
+                "gave_up": 0,
+                "reconnects": 0,
+                "retraces": 0,
+                "last_seen_s": None,
+                "health": None,
+            },
+        )
+        latest = docs[-1]
+        entry["health"] = {
+            key: latest.get(key)
+            for key in (
+                "round",
+                "n_obs",
+                "best_y",
+                "gp_mll",
+                "gp_ls_mean",
+                "gp_noise",
+                "acq_ei_max",
+                "q_unique_frac",
+                "tr_length",
+                "tr_succ",
+                "tr_fail",
+                "rung_occupancy",
+                "model_tier",
+                "algo",
+            )
+            if latest.get(key) is not None
+        }
+        entry["last_seen_s"] = round(
+            now - float(latest.get("time") or now), 3
+        )
+        times = [float(d.get("time") or 0.0) for d in docs]
+        window = max(times) - min(times)
+        if len(docs) >= 2 and window > 0:
+            entry["round_rate"] = round((len(docs) - 1) / window, 4)
+
+    return {
+        "experiment": experiment.name,
+        "version": experiment.version,
+        "time": now,
+        "workers": workers,
+        "incumbent": {
+            "best_y": best,
+            "round": best_doc.get("round") if best_doc else None,
+            "worker": best_doc.get("worker") if best_doc else None,
+        },
+        "regret_curve": curve,
+        "health_records": len(health_docs),
+    }
+
+
+def render_top(snap):
+    """Human frame for one snapshot."""
+    lines = [
+        f"orion-tpu top — {snap['experiment']} v{snap['version']}   "
+        f"workers: {len(snap['workers'])}   "
+        f"health records: {snap['health_records']}"
+    ]
+    incumbent = snap["incumbent"]
+    if incumbent["best_y"] is not None:
+        lines.append(
+            f"incumbent: {incumbent['best_y']:.6g} "
+            f"(worker {incumbent['worker']}, round {incumbent['round']})"
+        )
+    if snap["regret_curve"]:
+        lines.append(f"objective  {sparkline(snap['regret_curve'])}")
+    lines.append("")
+    header = (
+        f"{'worker':<24} {'rounds':>6} {'rate/s':>7} {'hb lag':>7} "
+        f"{'sto p99':>8} {'retry':>5} {'reconn':>6} {'best_y':>12} "
+        f"{'gp_mll':>8} {'tr_len':>6}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    for worker, row in sorted(snap["workers"].items()):
+        health = row.get("health") or {}
+
+        def fmt(value, spec):
+            return format(value, spec) if value is not None else "-"
+
+        lines.append(
+            f"{worker:<24} {row['rounds']:>6} "
+            f"{fmt(row['round_rate'], '7.2f'):>7} "
+            f"{fmt(row['heartbeat_lag_s'], '6.1f'):>7} "
+            f"{fmt(row['storage_p99_ms'], '7.1f'):>8} "
+            f"{row['retries']:>5} {row['reconnects']:>6} "
+            f"{fmt(health.get('best_y'), '12.5g'):>12} "
+            f"{fmt(health.get('gp_mll'), '8.3f'):>8} "
+            f"{fmt(health.get('tr_length'), '6.3f'):>6}"
+        )
+    return "\n".join(lines)
+
+
+def main(args):
+    experiment, _parser = build_from_args(
+        args, need_user_args=False, allow_create=False, view=True
+    )
+    if args.json:
+        print(json.dumps(snapshot_top(experiment)))
+        return 0
+    frames = 0
+    try:
+        while True:
+            snap = snapshot_top(experiment)
+            # ANSI clear + home, one frame per interval.
+            sys.stdout.write("\x1b[2J\x1b[H" + render_top(snap) + "\n")
+            sys.stdout.flush()
+            frames += 1
+            if args.iterations and frames >= args.iterations:
+                return 0
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0

@@ -16,6 +16,7 @@ import time
 from orion_tpu.core.consumer import Consumer
 from orion_tpu.core.experiment import DEFAULT_HEARTBEAT, DEFAULT_MAX_IDLE_TIME
 from orion_tpu.core.producer import Producer
+from orion_tpu.health import FLIGHT
 from orion_tpu.storage.retry import RetryPolicy, is_transient
 from orion_tpu.utils.exceptions import (
     AlgorithmExhausted,
@@ -86,6 +87,17 @@ def workon(
         iterations = _workon_loop(
             experiment, producer, consumer, worker_trials, on_error
         )
+    except BaseException as exc:
+        # Crash flight record (orion_tpu.health): dump the bounded ring of
+        # recent structured events (round boundaries, retries, reconnects,
+        # status transitions) as a JSONL artifact next to the crash, so
+        # the post-mortem starts with a timeline instead of a bare
+        # traceback.  None when the recorder is disabled; dump_crash never
+        # raises.
+        path = FLIGHT.dump_crash(experiment.name, exc)
+        if path:
+            log.error("worker crashed; flight record written to %s", path)
+        raise
     finally:
         # Final telemetry flush: the last round's spans/metrics (including
         # the closing producer.round span) would otherwise die with the

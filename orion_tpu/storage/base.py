@@ -14,6 +14,7 @@ import functools
 import time
 
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Trial
+from orion_tpu.health import FLIGHT
 from orion_tpu.storage.backends import PickledDB
 from orion_tpu.storage.documents import MemoryDB
 from orion_tpu.storage.retry import MODE_ALWAYS, MODE_UNAPPLIED, create_retry_policy
@@ -102,6 +103,13 @@ class BaseStorage:
         """Every stored span record for ``experiment``, time-ordered."""
         return []
 
+    def record_health(self, experiment, record, worker=None):
+        """Append one per-round optimization-health record (orion_tpu.health)."""
+
+    def fetch_health(self, experiment):
+        """Every stored health record for ``experiment``, time-ordered."""
+        return []
+
     def fetch_lies(self, experiment):
         raise NotImplementedError
 
@@ -156,6 +164,9 @@ INDEX_SPECS = [
     # upserted by (experiment, worker) on every worker flush round.
     ("metrics", ["experiment"], False),
     ("spans", ["experiment"], False),
+    # Optimization-health channel: one record per producer round, appended
+    # and pruned by (experiment, time) like the spans above.
+    ("health", ["experiment"], False),
 ]
 
 
@@ -628,6 +639,15 @@ class DocumentStorage(BaseStorage):
                 f"trial {trial.id} not updated to {status!r} (was={was!r})"
             )
         trial.status = status
+        # Status transitions are flight-recorder events (orion_tpu.health):
+        # the crash post-mortem wants the recent lifecycle edges on its
+        # timeline.  Guarded — the args dict must not allocate when the
+        # recorder is off (this is a per-trial path).
+        if FLIGHT.enabled:
+            FLIGHT.record(
+                "trial.status",
+                args={"trial": trial.id, "from": guard, "to": status},
+            )
         return Trial.from_dict(doc)
 
     @_traced("update_heartbeat", retry=MODE_ALWAYS)
@@ -850,6 +870,61 @@ class DocumentStorage(BaseStorage):
         docs.sort(key=lambda d: d.get("ts") or 0.0)
         return docs
 
+    # --- optimization-health channel (orion_tpu.health records) -------------
+    #: Health records are pruned past this per-experiment count — one
+    #: record per producer round, so the cap holds the recent few thousand
+    #: rounds of every worker (same unbounded-growth guard as SPANS_CAP).
+    HEALTH_CAP = 4096
+
+    def record_health(self, experiment, record, worker=None):
+        """Append one per-round health record (``BaseAlgorithm
+        .health_record()`` merged by the producer) in ONE backend write;
+        prunes the oldest past :attr:`HEALTH_CAP`."""
+        if not record:
+            return
+        self._append_health(experiment, record, worker)
+        self._prune_health(experiment)
+
+    # Append leg, same contract as record_spans: an ambiguous-loss resend
+    # would duplicate the round's record (skewing round-rate and regret
+    # curves), so give up on maybe_applied — the next round flushes fresh
+    # data anyway.  The prune leg retries separately so its transient
+    # failure can never re-run a landed append.
+    @_retrying("record_health", mode=MODE_UNAPPLIED)
+    def _append_health(self, experiment, record, worker=None):
+        doc = dict(record)
+        doc["experiment"] = _exp_id(experiment)
+        doc["worker"] = worker or _worker_id()
+        if doc.get("time") is None:
+            doc["time"] = time.time()
+        self._db.write("health", doc)
+
+    @_retrying("record_health.prune", mode=MODE_ALWAYS)
+    def _prune_health(self, experiment):
+        exp_id = _exp_id(experiment)
+        n = self._db.count("health", {"experiment": exp_id})
+        if n > self.HEALTH_CAP:
+            # Hysteresis to 90% of the cap, same rationale as _prune_spans:
+            # a prune-to-cap would re-pay the fetch+sort+remove on every
+            # later flush of a full collection.
+            keep = max(1, int(self.HEALTH_CAP * 0.9))
+            docs = self._db.read("health", {"experiment": exp_id})
+            # Index off the re-read list, not the earlier count: another
+            # worker's prune can land between count() and read().
+            if len(docs) <= keep:
+                return
+            docs.sort(key=lambda d: d.get("time") or 0.0)
+            cutoff = docs[len(docs) - keep].get("time") or 0.0
+            self._db.remove(
+                "health", {"experiment": exp_id, "time": {"$lt": cutoff}}
+            )
+
+    @_retrying("fetch_health", mode=MODE_ALWAYS)
+    def fetch_health(self, experiment):
+        docs = self._db.read("health", {"experiment": _exp_id(experiment)})
+        docs.sort(key=lambda d: d.get("time") or 0.0)
+        return docs
+
     @_retrying("fetch_noncompleted_trials", mode=MODE_ALWAYS)
     def fetch_noncompleted_trials(self, experiment):
         docs = self._db.read(
@@ -897,6 +972,7 @@ _READONLY_METHODS = {
     "fetch_timings",
     "fetch_metrics",
     "fetch_spans",
+    "fetch_health",
 }
 
 

@@ -36,6 +36,7 @@ import socketserver
 import threading
 import time
 
+from orion_tpu.health import FLIGHT
 from orion_tpu.storage.backends import atomic_pickle_dump
 from orion_tpu.storage.documents import MemoryDB
 from orion_tpu.telemetry import TELEMETRY
@@ -479,6 +480,14 @@ class NetworkDB:
         sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
         if self._ever_connected:
             self.reconnects += 1
+            # Reconnects are flight-recorder events (orion_tpu.health):
+            # the first symptom of a flapping link belongs on the crash
+            # timeline.  Guarded — no args allocation when disabled.
+            if FLIGHT.enabled:
+                FLIGHT.record(
+                    "storage.reconnect",
+                    args={"host": self.host, "port": self.port},
+                )
         self._ever_connected = True
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock

@@ -38,6 +38,7 @@ degradation (``core/worker.py``, ``core/pacemaker.py``).
 import random
 import time
 
+from orion_tpu.health import FLIGHT
 from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import (
     AuthenticationError,
@@ -169,8 +170,19 @@ class RetryPolicy:
                 )
                 if out_of_budget:
                     TELEMETRY.count("storage.gave_up")
+                    # Guarded (TEL004): the args dict must not allocate on
+                    # the disabled path — this sits inside the retry loop.
+                    if FLIGHT.enabled:
+                        FLIGHT.record(
+                            "storage.gave_up",
+                            args={"op": op, "attempts": attempt},
+                        )
                     raise
                 TELEMETRY.count("storage.retries")
+                if FLIGHT.enabled:
+                    FLIGHT.record(
+                        "storage.retry", args={"op": op, "attempt": attempt}
+                    )
                 self.sleep(attempt - 1, op=op)
 
 

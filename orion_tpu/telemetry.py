@@ -247,26 +247,61 @@ class Telemetry:
         if not self.enabled:
             return
         try:
-            now = time.perf_counter()
-            if start is None:
-                duration = float(duration or 0.0)
-                start = now - duration
-            elif duration is None:
-                duration = now - start
-            record = {
-                "name": name,
-                "ts": self._anchor + start,
-                "dur": float(duration),
-                "pid": os.getpid(),
-                "tid": threading.get_ident(),
-            }
-            if args:
-                record["args"] = dict(args)
+            record, duration = self._build_span_record(
+                name, start, duration, args, time.perf_counter()
+            )
             with self._lock:
                 self._ring[self._seq % self._capacity] = record
                 self._seq += 1
                 if histogram:
-                    self._observe_locked(name, float(duration))
+                    self._observe_locked(name, duration)
+        except Exception:  # pragma: no cover - must never raise into hot path
+            pass
+
+    def _build_span_record(self, name, start, duration, args, now):
+        """THE span-record builder — shared by :meth:`record_span` and
+        :meth:`record_spans_batch` so the None-start back-computation and
+        the record schema cannot drift between the per-call and batched
+        paths.  Returns ``(record, duration_seconds)``."""
+        if start is None:
+            duration = float(duration or 0.0)
+            start = now - duration
+        elif duration is None:
+            duration = now - start
+        record = {
+            "name": name,
+            "ts": self._anchor + start,
+            "dur": float(duration),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        }
+        if args:
+            record["args"] = dict(args)
+        return record, float(duration)
+
+    def record_spans_batch(self, entries):
+        """Record many finished spans under ONE lock acquisition.
+
+        ``entries`` is ``[(name, start, duration, args), ...]`` with the
+        same semantics as :meth:`record_span` (``start`` a perf_counter
+        reading; a None start is back-computed from ``duration`` against
+        the batch's shared "now").  The producer buffers its per-sample
+        spans across a round and flushes them here — per-sample
+        ``record_span`` calls each paid a lock round-trip and a clock read
+        inside the hot loop (see ``bench.py``'s ``telemetry_us_saved``)."""
+        if not self.enabled or not entries:
+            return
+        try:
+            now = time.perf_counter()
+            records = [
+                (name,) + self._build_span_record(name, start, duration, args, now)
+                for name, start, duration, args in entries
+            ]
+            with self._lock:
+                for name, record, duration in records:
+                    self._ring[self._seq % self._capacity] = record
+                    self._seq += 1
+                    self._observe_locked(name, duration)
         except Exception:  # pragma: no cover - must never raise into hot path
             pass
 

@@ -1,0 +1,43 @@
+"""TEL004 fixture: health/flight-record emission discipline.
+
+Allocating arguments to FLIGHT.record / storage record_health must be
+guarded by FLIGHT.enabled (or TELEMETRY.enabled) — the same disabled-path
+allocation contract TEL003 enforces for TELEMETRY mutators.
+"""
+
+from orion_tpu.health import FLIGHT
+from orion_tpu.telemetry import TELEMETRY
+
+
+def bad_unguarded_flight_event(round_index):
+    FLIGHT.record("producer.round", args={"round": round_index})  # expect: TEL004
+
+
+def bad_fstring_kind(op):
+    FLIGHT.record(f"storage.{op}")  # expect: TEL004
+
+
+def bad_unguarded_record_health(storage, experiment, best):
+    storage.record_health(experiment, {"best_y": best})  # expect: TEL004
+
+
+def good_guarded_flight_event(round_index):
+    if FLIGHT.enabled:
+        FLIGHT.record("producer.round", args={"round": round_index})
+
+
+def good_guarded_by_telemetry(storage, experiment, best):
+    if TELEMETRY.enabled:
+        storage.record_health(experiment, {"best_y": best})
+
+
+def good_early_exit_guard(round_index):
+    if not FLIGHT.enabled:
+        return
+    FLIGHT.record("producer.round", args={"round": round_index})
+
+
+def good_non_allocating_args(storage, experiment, record):
+    # A plain variable argument allocates nothing — quiet without a guard.
+    FLIGHT.record("producer.round")
+    storage.record_health(experiment, record)

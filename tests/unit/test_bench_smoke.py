@@ -19,9 +19,11 @@ BREAKDOWN_KEYS = (
     "upload",
     "dispatch",
     "wait_transfer",
+    "health",
     "decode",
     "dict_build",
     "storage_ms",
+    "telemetry_us_saved",
 )
 
 #: Spans every bench trace must carry: the produce round, its batched
@@ -78,12 +80,37 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
         assert key in breakdown, f"breakdown_ms lost its {key!r} stage"
     # Steady-state host tax, trackable across BENCH_* separately from
     # throughput: the sum of the host stages (everything except
-    # wait_transfer and the separately-tracked storage_ms).
+    # wait_transfer, the separately-tracked storage_ms, and the
+    # telemetry_us_saved savings report).
     assert payload["host_ms_per_round"] == round(
         sum(v for k, v in breakdown.items()
-            if k not in ("wait_transfer", "storage_ms")),
+            if k not in ("wait_transfer", "storage_ms", "telemetry_us_saved")),
         3,
     )
+    # Health recording stays under 1% of the steady-state round (bench.py
+    # hard-asserts the same bar before emitting).
+    round_ms = sum(
+        v for k, v in breakdown.items()
+        if k not in ("storage_ms", "telemetry_us_saved")
+    )
+    assert breakdown["health"] <= 0.01 * round_ms
+    # The optimization-health payload: a real per-round regret curve with
+    # GP/TR health fields (orion_tpu.health).
+    health = payload["health"]
+    assert len(health["regret_curve"]) >= 2
+    assert health["rounds"] >= 1 and health["gp_mll"]
+    assert health["last"]["gp_mll"] is not None
+    assert health["last"]["q_unique_frac"] is not None
+    assert health["last"]["tr_length"] is not None
+    # Monotone non-increasing incumbent regret.
+    curve = health["regret_curve"]
+    assert all(b <= a + 1e-12 for a, b in zip(curve, curve[1:]))
+    # The statistical regret gate: smoke checks the machinery against the
+    # committed baseline (self-comparison must pass; the synthetic-shift
+    # failure case is pinned in tests/unit/test_regret_gate.py).
+    gate = payload["regret_gate"]
+    assert gate["pass"] is True and gate["mode"] == "baseline-self"
+    assert gate["final"]["p_value"] is not None and gate["auc"] is not None
     # The pow-2 boundary-crossing contract: a prewarmed crossing costs a
     # jit-cache hit, not a synchronous retrace (None = jax introspection
     # unavailable — skipped, not failed; bench.py itself hard-asserts 0).

@@ -1,0 +1,503 @@
+"""Optimization-health subsystem tests (orion_tpu.health + the storage
+health channel + the flight recorder): record roundtrip and retention-cap
+pruning on all four backends, BaseStorage no-op defaults, producer
+emission end to end, cross-worker merge in `orion-tpu info`, flight-ring
+semantics, crash/audit-failure dumps.
+"""
+
+import json
+
+import pytest
+
+from orion_tpu.health import (
+    DEVICE_HEALTH_FIELDS,
+    FlightRecorder,
+    flight_events_as_spans,
+    spans_as_flight_events,
+    unpack_device_health,
+)
+from orion_tpu.storage.base import BaseStorage, DocumentStorage, create_storage
+
+
+@pytest.fixture(params=["memory", "pickled", "sqlite", "network"])
+def storage(request, tmp_path):
+    if request.param == "memory":
+        yield create_storage({"type": "memory"})
+        return
+    if request.param == "pickled":
+        yield create_storage({"type": "pickled", "path": str(tmp_path / "db.pkl")})
+        return
+    if request.param == "sqlite":
+        yield create_storage({"type": "sqlite", "path": str(tmp_path / "db.sqlite")})
+        return
+    from orion_tpu.storage import DBServer
+
+    server = DBServer(port=0)
+    host, port = server.serve_background()
+    yield create_storage({"type": "network", "host": host, "port": port})
+    server.shutdown()
+    server.server_close()
+
+
+def _record(i, **extra):
+    base = {
+        "algo": "tpubo",
+        "round": i,
+        "n_obs": 16 + i,
+        "best_y": 1.0 - 0.01 * i,
+        "gp_mll": -0.5,
+        "tr_length": 0.8,
+        "time": 1000.0 + i,
+    }
+    base.update(extra)
+    return base
+
+
+# --- device-vector pack/unpack ---------------------------------------------
+
+
+def test_unpack_device_health_roundtrip():
+    vec = [float(i) for i in range(len(DEVICE_HEALTH_FIELDS))]
+    out = unpack_device_health(vec)
+    assert tuple(out) == DEVICE_HEALTH_FIELDS
+    assert out["gp_mll"] == 0.0 and out["q_unique_frac"] == float(
+        len(DEVICE_HEALTH_FIELDS) - 1
+    )
+
+
+def test_unpack_device_health_short_vector_is_empty():
+    assert unpack_device_health([1.0, 2.0]) == {}
+
+
+# --- storage channel --------------------------------------------------------
+
+
+def test_health_roundtrip_all_backends(storage):
+    exp = storage.create_experiment({"name": "h", "metadata": {"user": "u"}})
+    for i in range(5):
+        storage.record_health(exp, _record(i), worker=f"w{i % 2}")
+    docs = storage.fetch_health(exp)
+    assert len(docs) == 5
+    # Time-ordered, worker stamped, payload fields intact.
+    assert [d["round"] for d in docs] == [0, 1, 2, 3, 4]
+    assert {d["worker"] for d in docs} == {"w0", "w1"}
+    assert docs[-1]["best_y"] == pytest.approx(0.96)
+    assert docs[-1]["gp_mll"] == pytest.approx(-0.5)
+
+
+def test_health_empty_record_is_noop(storage):
+    exp = storage.create_experiment({"name": "h0", "metadata": {"user": "u"}})
+    storage.record_health(exp, None)
+    storage.record_health(exp, {})
+    assert storage.fetch_health(exp) == []
+
+
+def test_health_retention_cap_prunes_oldest(storage, monkeypatch):
+    monkeypatch.setattr(DocumentStorage, "HEALTH_CAP", 20)
+    exp = storage.create_experiment({"name": "hc", "metadata": {"user": "u"}})
+    for i in range(50):
+        storage.record_health(exp, _record(i), worker="w0")
+    docs = storage.fetch_health(exp)
+    assert len(docs) <= 20
+    # The newest records survive; pruning eats from the oldest end.
+    rounds = [d["round"] for d in docs]
+    assert rounds[-1] == 49
+    assert min(rounds) >= 50 - 20
+
+
+def test_base_storage_defaults_are_noops():
+    class Minimal(BaseStorage):
+        pass
+
+    storage = Minimal()
+    assert storage.record_health("exp", {"best_y": 1.0}) is None
+    assert storage.fetch_health("exp") == []
+
+
+def test_health_worker_defaults_to_host_pid():
+    storage = create_storage({"type": "memory"})
+    exp = storage.create_experiment({"name": "hw", "metadata": {"user": "u"}})
+    storage.record_health(exp, _record(0))
+    doc = storage.fetch_health(exp)[0]
+    assert ":" in doc["worker"]
+
+
+# --- producer emission end to end ------------------------------------------
+
+
+def test_producer_emits_health_records_and_flight_spans():
+    from orion_tpu import telemetry as tel
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.health import FLIGHT
+
+    was_tel, was_flight = tel.TELEMETRY.enabled, FLIGHT.enabled
+    tel.TELEMETRY.enable()
+    FLIGHT.enable()
+    try:
+        storage = create_storage({"type": "memory"})
+        exp = build_experiment(
+            storage,
+            "health-producer",
+            priors={f"x{i}": "uniform(0, 1)" for i in range(3)},
+            algorithms={
+                "tpu_bo": {
+                    "n_init": 2,
+                    "n_candidates": 64,
+                    "fit_steps": 2,
+                    "prewarm": False,
+                    "seed": 0,
+                }
+            },
+            metadata={"user": "t"},
+        )
+        exp.instantiate(seed=0)
+        producer = Producer(exp)
+        producer.update()
+        producer.produce(4)
+        producer._flush_timings(force_metrics=True)
+        docs = storage.fetch_health(exp)
+        assert docs, "producer flushed no health record"
+        record = docs[-1]
+        assert record["round"] == 1 and record["registered"] == 4
+        assert record["algo"] == "tpubo"
+        assert record["n_obs"] == 0  # real algorithm saw no completions yet
+        # Flight round boundary mirrored into the spans channel.
+        spans = storage.fetch_spans(exp)
+        events = spans_as_flight_events(spans)
+        assert any(e["kind"] == "producer.round" for e in events)
+    finally:
+        if not was_tel:
+            tel.TELEMETRY.disable()
+        if not was_flight:
+            FLIGHT.disable()
+
+
+def test_producer_emits_nothing_when_telemetry_disabled():
+    from orion_tpu import telemetry as tel
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.health import FLIGHT
+
+    assert not tel.TELEMETRY.enabled and not FLIGHT.enabled
+    storage = create_storage({"type": "memory"})
+    exp = build_experiment(
+        storage,
+        "health-disabled",
+        priors={"x0": "uniform(0, 1)"},
+        algorithms={"random": {"seed": 0}},
+        metadata={"user": "t"},
+    )
+    exp.instantiate(seed=0)
+    producer = Producer(exp)
+    producer.update()
+    producer.produce(2)
+    assert storage.fetch_health(exp) == []
+
+
+# --- cross-worker merge in info --------------------------------------------
+
+
+def test_info_health_section_merges_workers():
+    from orion_tpu.cli.info import _health_section
+
+    storage = create_storage({"type": "memory"})
+    exp = storage.create_experiment({"name": "hm", "metadata": {"user": "u"}})
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "hm"
+            self.id = exp["_id"]
+
+    for i in range(3):
+        storage.record_health(exp, _record(i, best_y=0.5 - 0.1 * i), worker="w-a")
+    storage.record_health(
+        exp,
+        _record(
+            9,
+            best_y=0.05,
+            rung_occupancy=[[[1, 9, 7], [3, 3, 3]], [[3, 2, 1]]],
+        ),
+        worker="w-b",
+    )
+    lines = _health_section(_Exp())
+    text = "\n".join(lines)
+    assert "4 from 2 worker(s)" in text
+    # The fleet-wide incumbent is the MIN across workers (w-b's 0.05).
+    assert "incumbent best_y: 0.05" in text
+    # Both workers' latest records are shown, labeled.
+    assert "w-a:" in text and "w-b:" in text
+    # EVERY bracket renders (a starved rung can sit in any ladder), as
+    # resources:occupied(evaluated).
+    assert "rungs[b0] 1:9(7) 3:3(3)" in text
+    assert "rungs[b1] 3:2(1)" in text
+
+
+def test_info_per_worker_telemetry_blocks():
+    from orion_tpu.cli.info import _telemetry_section
+
+    storage = create_storage({"type": "memory"})
+    exp = storage.create_experiment({"name": "pw", "metadata": {"user": "u"}})
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.id = exp["_id"]
+
+    for worker, lag in (("w-a", 0.5), ("w-b", 9.5)):
+        storage.record_metrics(
+            exp,
+            {
+                "counters": {"storage.retries": 2},
+                "gauges": {"pacemaker.heartbeat_lag_s": lag},
+                "histograms": {},
+            },
+            worker=worker,
+        )
+    merged = "\n".join(_telemetry_section(_Exp()))
+    # Merged view: MAX gauge hides which worker lags.
+    assert "9.5" in merged and "w-a" not in merged
+    per_worker = "\n".join(_telemetry_section(_Exp(), per_worker=True))
+    assert "--- worker w-a" in per_worker and "--- worker w-b" in per_worker
+    assert "0.5" in per_worker and "9.5" in per_worker
+
+
+# --- flight recorder --------------------------------------------------------
+
+
+def test_flight_disabled_record_is_noop():
+    recorder = FlightRecorder(enabled=False, capacity=16)
+    recorder.record("x", args={"a": 1})
+    assert recorder.events() == []
+
+
+def test_flight_ring_bounded_and_drain_once():
+    recorder = FlightRecorder(enabled=True, capacity=8)
+    for i in range(20):
+        recorder.record("tick", args={"i": i})
+    events = recorder.events()
+    assert len(events) == 8
+    assert [e["args"]["i"] for e in events] == list(range(12, 20))
+    drained = recorder.drain()
+    assert [e["args"]["i"] for e in drained] == list(range(12, 20))
+    assert recorder.drain() == []
+    recorder.record("tick", args={"i": 99})
+    assert [e["args"]["i"] for e in recorder.drain()] == [99]
+
+
+def test_flight_dump_writes_header_and_events(tmp_path):
+    recorder = FlightRecorder(enabled=True, capacity=8)
+    recorder.record("producer.round", args={"round": 1})
+    path = recorder.dump(str(tmp_path / "f.jsonl"), reason="test")
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["type"] == "flight-record" and lines[0]["reason"] == "test"
+    assert lines[0]["events"] == 1
+    assert lines[1]["kind"] == "producer.round"
+
+
+def test_flight_dump_crash_includes_traceback(tmp_path):
+    recorder = FlightRecorder(enabled=True, capacity=8)
+    recorder.record("tick")
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as exc:
+        path = recorder.dump_crash("exp-name", exc, directory=str(tmp_path))
+    assert path is not None
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["reason"] == "crash"
+    crash = lines[-1]
+    assert crash["kind"] == "crash"
+    assert "boom" in crash["args"]["error"]
+    assert "RuntimeError" in crash["args"]["traceback"]
+
+
+def test_flight_dump_crash_disabled_returns_none(tmp_path):
+    recorder = FlightRecorder(enabled=False)
+    assert recorder.dump_crash("x", RuntimeError(), directory=str(tmp_path)) is None
+
+
+def test_flight_span_mirror_roundtrip():
+    events = [
+        {"kind": "storage.retry", "ts": 10.0, "pid": 7, "args": {"op": "a"}},
+        {"kind": "producer.round", "ts": 11.0, "pid": 7},
+    ]
+    spans = flight_events_as_spans(events)
+    assert [s["name"] for s in spans] == ["flight.storage.retry", "flight.producer.round"]
+    assert all(s["dur"] == 0.0 for s in spans)
+    back = spans_as_flight_events(
+        spans + [{"name": "producer.round", "ts": 1.0}]  # non-flight span dropped
+    )
+    assert [e["kind"] for e in back] == ["storage.retry", "producer.round"]
+    assert back[0]["args"] == {"op": "a"}
+
+
+def test_workon_crash_dumps_flight_record(tmp_path, monkeypatch):
+    """A crashing worker loop leaves the flight-record JSONL artifact."""
+    from orion_tpu.core import worker as worker_mod
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.health import FLIGHT
+    from orion_tpu.io.cmdline import CommandLineParser
+
+    was = FLIGHT.enabled
+    FLIGHT.enable()
+    monkeypatch.chdir(tmp_path)
+    try:
+        FLIGHT.record("tick", args={"i": 1})
+        storage = create_storage({"type": "memory"})
+        exp = build_experiment(
+            storage,
+            "crash-exp",
+            priors={"x0": "uniform(0, 1)"},
+            algorithms={"random": {"seed": 0}},
+            metadata={"user": "t"},
+        )
+        exp.instantiate(seed=0)
+
+        def boom(*_args, **_kwargs):
+            raise RuntimeError("mid-hunt crash")
+
+        monkeypatch.setattr(worker_mod, "_workon_loop", boom)
+        with pytest.raises(RuntimeError, match="mid-hunt crash"):
+            worker_mod.workon(exp, CommandLineParser(), worker_trials=1)
+        artifacts = list(tmp_path.glob("flight-crash-exp-*.jsonl"))
+        assert len(artifacts) == 1
+        lines = [json.loads(line) for line in open(artifacts[0])]
+        assert lines[0]["reason"] == "crash"
+        assert lines[-1]["kind"] == "crash"
+        assert "mid-hunt crash" in lines[-1]["args"]["error"]
+        assert any(e.get("kind") == "tick" for e in lines[1:])
+    finally:
+        if not was:
+            FLIGHT.disable()
+
+
+# --- audit-failure dump -----------------------------------------------------
+
+
+def test_audit_cli_failure_leaves_flight_artifact(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+
+    db_path = str(tmp_path / "audit.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment({"name": "bad-exp", "metadata": {"user": "u"}})
+    # A completed trial with no objective result = a lost observation.
+    storage.db.write(
+        "trials",
+        {
+            "_id": "t-bad",
+            "experiment": exp["_id"],
+            "status": "completed",
+            "params": {"x": 1.0},
+            "results": [],
+            "submit_time": 1.0,
+            "end_time": 2.0,
+        },
+    )
+    out = str(tmp_path / "audit-flight.jsonl")
+    rc = cli_main(
+        [
+            "audit",
+            "-n",
+            "bad-exp",
+            "--storage-path",
+            db_path,
+            "--flight-out",
+            out,
+        ]
+    )
+    assert rc == 1
+    lines = [json.loads(line) for line in open(out)]
+    assert lines[0]["reason"] == "audit-failure"
+    violations = [e for e in lines[1:] if e["kind"] == "audit.violation"]
+    assert violations and violations[0]["args"]["check"] == "lost-observation"
+    assert "flight record written" in capsys.readouterr().out
+
+
+def test_flight_record_cli_reconstructs_from_storage(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+
+    db_path = str(tmp_path / "fr.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment({"name": "fr-exp", "metadata": {"user": "u"}})
+    # What a worker's flush leaves behind: flight.* records in the spans
+    # channel next to ordinary spans.
+    storage.record_spans(
+        exp,
+        flight_events_as_spans(
+            [
+                {"kind": "producer.round", "ts": 10.0, "pid": 1, "args": {"round": 1}},
+                {"kind": "storage.retry", "ts": 11.0, "pid": 1, "args": {"op": "x"}},
+            ]
+        )
+        + [{"name": "producer.round", "ts": 12.0, "dur": 0.1, "pid": 1, "tid": 0}],
+    )
+    out = str(tmp_path / "fr.jsonl")
+    rc = cli_main(
+        ["flight-record", "-n", "fr-exp", "--storage-path", db_path, "--out", out]
+    )
+    assert rc == 0
+    lines = [json.loads(line) for line in open(out)]
+    assert lines[0]["type"] == "flight-record"
+    kinds = [e.get("kind") for e in lines[1:]]
+    assert "producer.round" in kinds and "storage.retry" in kinds
+    assert "wrote" in capsys.readouterr().out
+
+
+def test_flight_record_cli_empty_returns_1(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+    from orion_tpu.health import FLIGHT
+
+    db_path = str(tmp_path / "fr0.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    storage.create_experiment({"name": "fr0-exp", "metadata": {"user": "u"}})
+    FLIGHT.clear()
+    rc = cli_main(["flight-record", "-n", "fr0-exp", "--storage-path", db_path])
+    assert rc == 1
+    assert "no flight events" in capsys.readouterr().out
+
+
+def test_audit_cli_failure_without_optin_scatters_nothing(tmp_path, capsys, monkeypatch):
+    """No --flight-out and a disabled recorder: a failed audit must NOT
+    drop an artifact into cwd (a cron audit never opted into
+    observability) — it prints the hint instead."""
+    from orion_tpu.cli import main as cli_main
+    from orion_tpu.health import FLIGHT
+
+    assert not FLIGHT.enabled
+    monkeypatch.chdir(tmp_path)
+    db_path = str(tmp_path / "noart.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment({"name": "noart-exp", "metadata": {"user": "u"}})
+    storage.db.write(
+        "trials",
+        {
+            "_id": "t-bad",
+            "experiment": exp["_id"],
+            "status": "completed",
+            "params": {"x": 1.0},
+            "results": [],
+            "submit_time": 1.0,
+            "end_time": 2.0,
+        },
+    )
+    rc = cli_main(["audit", "-n", "noart-exp", "--storage-path", db_path])
+    assert rc == 1
+    assert not list(tmp_path.glob("flight-*.jsonl"))
+    assert "--flight-out" in capsys.readouterr().out
+
+
+def test_audit_cli_clean_leaves_no_artifact(tmp_path):
+    from orion_tpu.cli import main as cli_main
+
+    db_path = str(tmp_path / "clean.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    storage.create_experiment({"name": "ok-exp", "metadata": {"user": "u"}})
+    out = str(tmp_path / "nope.jsonl")
+    rc = cli_main(
+        ["audit", "-n", "ok-exp", "--storage-path", db_path, "--flight-out", out]
+    )
+    assert rc == 0
+    import os
+
+    assert not os.path.exists(out)

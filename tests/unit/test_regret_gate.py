@@ -1,0 +1,165 @@
+"""Statistical regret-regression gate tests
+(orion_tpu.benchmarks.regret_gate): the gate must fail on synthetically
+regressed curve sets, pass on identical/noisy/improved ones, and the
+committed BENCH_REGRET_BASELINE.json must be loadable and self-consistent.
+"""
+
+import json
+import os
+
+import pytest
+
+from orion_tpu.benchmarks.regret_gate import (
+    bootstrap_median_shift,
+    curve_auc,
+    evaluate_regret_gate,
+    load_baseline,
+    mann_whitney_u,
+)
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "BENCH_REGRET_BASELINE.json",
+)
+
+
+def _curves():
+    """Five synthetic descending regret curves with seed spread."""
+    out = []
+    for seed in range(5):
+        start = 1.0 + 0.1 * seed
+        final = 0.02 + 0.01 * seed
+        curve = [start * (final / start) ** (i / 10.0) for i in range(11)]
+        out.append(curve)
+    return out
+
+
+# --- the U test -------------------------------------------------------------
+
+
+def test_mann_whitney_separated_is_significant():
+    _u, p = mann_whitney_u([3, 4, 5, 6, 7], [0.1, 0.2, 0.3, 0.4, 0.5])
+    assert p < 0.01
+
+
+def test_mann_whitney_identical_is_not_significant():
+    _u, p = mann_whitney_u([1, 2, 3, 4, 5], [1, 2, 3, 4, 5])
+    assert p > 0.3
+
+
+def test_mann_whitney_improvement_has_high_p():
+    # `current` SMALLER than baseline: one-sided p toward "larger" ~ 1.
+    _u, p = mann_whitney_u([0.1, 0.2], [3, 4, 5])
+    assert p > 0.9
+
+
+def test_mann_whitney_empty_inputs():
+    assert mann_whitney_u([], [1.0]) == (0.0, 1.0)
+
+
+def test_bootstrap_shift_excludes_zero_on_clear_separation():
+    lo, hi = bootstrap_median_shift([10, 11, 12, 13, 14], [1, 2, 3, 4, 5])
+    assert lo > 0 and hi >= lo
+
+
+def test_curve_auc_orders_slower_descent_worse():
+    fast = [1.0, 0.1, 0.01, 0.01]
+    slow = [1.0, 0.9, 0.5, 0.01]  # same final, slower trajectory
+    assert curve_auc(slow) > curve_auc(fast)
+
+
+# --- the gate ---------------------------------------------------------------
+
+
+def test_gate_passes_on_identical_curves():
+    curves = _curves()
+    verdict = evaluate_regret_gate(curves, curves)
+    assert verdict["pass"] is True
+    assert not verdict["final"]["regressed"] and not verdict["auc"]["regressed"]
+
+
+def test_gate_fails_on_shifted_curves():
+    curves = _curves()
+    regressed = [[x + 0.5 for x in c] for c in curves]
+    verdict = evaluate_regret_gate(regressed, curves)
+    assert verdict["pass"] is False
+    assert verdict["final"]["regressed"]
+    assert verdict["final"]["p_value"] < verdict["alpha"]
+
+
+def test_gate_fails_on_slower_trajectories():
+    # Same finals, 3x the regret all the way down: the AUC criterion
+    # catches what the final value hides.
+    curves = _curves()
+    slower = [[x * 3.0 for x in c[:-1]] + [c[-1]] for c in curves]
+    verdict = evaluate_regret_gate(slower, curves)
+    assert verdict["pass"] is False
+    assert verdict["auc"]["regressed"]
+
+
+def test_gate_passes_on_improvement():
+    curves = _curves()
+    improved = [[x * 0.2 for x in c] for c in curves]
+    verdict = evaluate_regret_gate(improved, curves)
+    assert verdict["pass"] is True
+
+
+def test_gate_passes_on_seed_noise():
+    import random
+
+    rng = random.Random(7)
+    curves = _curves()
+    noisy = [[x * (1.0 + 0.1 * (2 * rng.random() - 1)) for x in c] for c in curves]
+    verdict = evaluate_regret_gate(noisy, curves)
+    assert verdict["pass"] is True
+
+
+def test_gate_verdict_schema():
+    curves = _curves()
+    verdict = evaluate_regret_gate(curves, curves)
+    for key in ("pass", "alpha", "min_rel_effect", "seeds", "final", "auc"):
+        assert key in verdict
+    for block in (verdict["final"], verdict["auc"]):
+        for key in ("p_value", "shift_ci95", "regressed"):
+            assert key in block
+    json.dumps(verdict)  # must be JSON-serializable as emitted by bench.py
+
+
+# --- the committed baseline -------------------------------------------------
+
+
+def test_committed_baseline_loads_and_matches_schema():
+    with open(BASELINE_PATH) as handle:
+        data = json.load(handle)
+    assert data["seeds"] == list(range(len(data["curves"])))
+    assert data["final"] == [c[-1] for c in data["curves"]]
+    assert data["justification"]
+    curves = load_baseline(BASELINE_PATH)
+    assert len(curves) >= 5
+    for curve in curves:
+        assert len(curve) >= 2
+        # Incumbent regret is monotone non-increasing and positive.
+        assert all(b <= a + 1e-12 for a, b in zip(curve, curve[1:]))
+        assert all(v > 0 for v in curve)
+
+
+def test_committed_baseline_passes_its_own_gate():
+    curves = load_baseline(BASELINE_PATH)
+    verdict = evaluate_regret_gate(curves, curves)
+    assert verdict["pass"] is True
+
+
+def test_committed_baseline_gate_detects_synthetic_regression():
+    curves = load_baseline(BASELINE_PATH)
+    regressed = [[x + 0.5 for x in c] for c in curves]
+    verdict = evaluate_regret_gate(regressed, curves)
+    assert verdict["pass"] is False
+
+
+@pytest.mark.parametrize("factor", [1.0, 0.9])
+def test_gate_is_deterministic(factor):
+    curves = _curves()
+    scaled = [[x * factor for x in c] for c in curves]
+    first = evaluate_regret_gate(scaled, curves)
+    second = evaluate_regret_gate(scaled, curves)
+    assert first == second

@@ -1,0 +1,174 @@
+"""`orion-tpu top` tests: the --json one-shot schema over a seeded
+storage (fabricated multi-worker metrics + health docs), the sparkline
+renderer, and the live-frame renderer's degradation with partial data.
+"""
+
+import json
+
+import pytest
+
+from orion_tpu.cli.top import render_top, snapshot_top, sparkline
+from orion_tpu.storage.base import create_storage
+
+
+def _seed_storage(tmp_path):
+    db_path = str(tmp_path / "top.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment({"name": "top-exp", "metadata": {"user": "u"}})
+    buckets = [0] * 48
+    buckets[20] = 9  # ~1ms samples
+    hist = {"buckets": buckets, "count": 9, "sum": 0.009, "min": 1e-3, "max": 2e-3}
+    for worker, lag, retries in (("host-a:1", 0.4, 2), ("host-b:2", 7.5, 11)):
+        storage.record_metrics(
+            exp,
+            {
+                "counters": {
+                    "storage.retries": retries,
+                    "storage.network.reconnects": 1,
+                    "jax.retraces": 3,
+                },
+                "gauges": {"pacemaker.heartbeat_lag_s": lag},
+                "histograms": {
+                    "producer.round": {**hist, "count": 6},
+                    "storage.sqlite.register_trials": hist,
+                },
+            },
+            worker=worker,
+        )
+    for i in range(6):
+        worker = "host-a:1" if i % 2 == 0 else "host-b:2"
+        storage.record_health(
+            exp,
+            {
+                "algo": "tpubo",
+                "round": i + 1,
+                "n_obs": 8 * (i + 1),
+                "best_y": 1.0 / (i + 1),
+                "gp_mll": -0.2,
+                "tr_length": 0.8,
+                "q_unique_frac": 1.0,
+                "time": 100.0 + 2.0 * i,
+            },
+            worker=worker,
+        )
+    return db_path, storage, exp
+
+
+def test_top_json_one_shot_schema(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+
+    db_path, _storage, _exp = _seed_storage(tmp_path)
+    rc = cli_main(["top", "-n", "top-exp", "--storage-path", db_path, "--json"])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["experiment"] == "top-exp"
+    assert set(snap["workers"]) == {"host-a:1", "host-b:2"}
+    for row in snap["workers"].values():
+        for key in (
+            "rounds",
+            "round_rate",
+            "heartbeat_lag_s",
+            "storage_p99_ms",
+            "retries",
+            "reconnects",
+            "retraces",
+            "health",
+        ):
+            assert key in row
+    a = snap["workers"]["host-a:1"]
+    assert a["retries"] == 2 and a["reconnects"] == 1 and a["retraces"] == 3
+    assert a["heartbeat_lag_s"] == pytest.approx(0.4)
+    assert a["storage_p99_ms"] is not None and a["storage_p99_ms"] > 0
+    assert a["rounds"] == 6  # producer.round histogram count
+    # Health joined onto the worker row: the worker's LATEST record.
+    assert a["health"]["round"] == 5 and a["health"]["best_y"] == pytest.approx(0.2)
+    # Rate derived from the health-record timestamps (4s window, 3 records).
+    assert a["round_rate"] == pytest.approx(2 / 8.0)
+    # Fleet-wide incumbent + monotone regret curve across workers.
+    assert snap["incumbent"]["best_y"] == pytest.approx(1.0 / 6)
+    curve = snap["regret_curve"]
+    assert len(curve) == 6
+    assert all(b <= a_ + 1e-12 for a_, b in zip(curve, curve[1:]))
+
+
+def test_top_snapshot_health_only_worker(tmp_path):
+    """A worker that flushed health but no metrics snapshot still appears
+    (fresh worker between metrics intervals)."""
+    storage = create_storage({"type": "memory"})
+    exp = storage.create_experiment({"name": "h", "metadata": {"user": "u"}})
+    storage.record_health(
+        exp, {"round": 1, "best_y": 0.5, "time": 10.0}, worker="w-new"
+    )
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "h"
+            self.version = 1
+            self.id = exp["_id"]
+
+    snap = snapshot_top(_Exp(), now=12.0)
+    assert snap["workers"]["w-new"]["health"]["best_y"] == 0.5
+    assert snap["workers"]["w-new"]["last_seen_s"] == pytest.approx(2.0)
+    # One record = no rate window yet.
+    assert snap["workers"]["w-new"]["round_rate"] is None
+
+
+def test_render_top_degrades_with_partial_data(tmp_path):
+    _db, storage, exp = _seed_storage(tmp_path)
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "top-exp"
+            self.version = 1
+            self.id = exp["_id"]
+
+    frame = render_top(snapshot_top(_Exp()))
+    assert "orion-tpu top — top-exp" in frame
+    assert "host-a:1" in frame and "host-b:2" in frame
+    assert "incumbent:" in frame
+    # Empty experiment renders too (no crash on zero data).
+    storage2 = create_storage({"type": "memory"})
+    exp2 = storage2.create_experiment({"name": "empty", "metadata": {"user": "u"}})
+
+    class _Empty:
+        def __init__(self):
+            self.storage = storage2
+            self.name = "empty"
+            self.version = 1
+            self.id = exp2["_id"]
+
+    frame2 = render_top(snapshot_top(_Empty()))
+    assert "workers: 0" in frame2
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert sparkline([1.0]) == "▁"
+    line = sparkline([5, 4, 3, 2, 1])
+    assert len(line) == 5 and line[0] == "█" and line[-1] == "▁"
+    long = sparkline(list(range(200)), width=40)
+    assert len(long) == 40 and long[-1] == "█"
+
+
+def test_top_iterations_live_mode_exits(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+
+    db_path, _storage, _exp = _seed_storage(tmp_path)
+    rc = cli_main(
+        [
+            "top",
+            "-n",
+            "top-exp",
+            "--storage-path",
+            db_path,
+            "--iterations",
+            "1",
+            "-i",
+            "0.1",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "orion-tpu top — top-exp" in out
