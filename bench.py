@@ -448,6 +448,161 @@ def bench_prewarm(q=16):
             tel.TELEMETRY.disable()
 
 
+def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
+                fit_steps=4):
+    """The multi-tenant suggest gateway, full stack (orion_tpu.serve):
+    M concurrent experiments — each a REAL producer/worker loop over one
+    shared sqlite store, its algorithm a gateway-backed RemoteAlgorithm —
+    drive one in-process GatewayServer with barrier-synchronized rounds so
+    concurrent suggest traffic actually lands in the coalescing window.
+
+    Hard asserts (the serving contract, ISSUE 8):
+
+    - **coalescing happened**: at least one dispatch stacked >= 2 tenants
+      (``max_width >= 2``), and device dispatches per suggest < 1 — M
+      suggests cost fewer than M device calls;
+    - **storage invariants hold**: `orion-tpu audit` is clean for every
+      tenant experiment after the run (served rounds register/complete
+      trials exactly like local ones).
+
+    Returns the ``serve`` payload block: coalesce width stats, device
+    dispatches per suggest, per-tenant request p50/p99 (from the gateway's
+    per-tenant telemetry histograms), backpressure/eviction counts."""
+    import os
+    import tempfile
+    import threading
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.client.experiment import ExperimentClient
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.serve.gateway import GatewayServer
+    from orion_tpu.storage.audit import audit_experiment
+    from orion_tpu.storage.base import create_storage
+    from orion_tpu.telemetry import histogram_percentile
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    server = GatewayServer(window=window, max_width=max(2, m_tenants))
+    host, port = server.serve_background()
+    barrier = threading.Barrier(m_tenants)
+    errors, reports = [], {}
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-serve-") as tmp:
+            storage = create_storage(
+                {"type": "sqlite", "path": os.path.join(tmp, "serve.sqlite")}
+            )
+
+            def run_tenant(index):
+                try:
+                    experiment = build_experiment(
+                        storage,
+                        f"bench-serve-{index}",
+                        priors={f"x{j}": "uniform(0, 1)" for j in range(6)},
+                        algorithms={
+                            "tpu_bo": {
+                                "n_init": q,
+                                "n_candidates": n_candidates,
+                                "fit_steps": fit_steps,
+                            }
+                        },
+                        pool_size=q,
+                        metadata={"user": "bench"},
+                    )
+                    experiment.serve_config = {"address": f"{host}:{port}"}
+                    experiment.instantiate(seed=SEED + index)
+                    client = ExperimentClient(experiment)
+                    for _ in range(rounds):
+                        # Round barrier: the gateway's coalescing window is
+                        # small; the bench must present genuinely
+                        # concurrent traffic, as M live workers would.
+                        barrier.wait(timeout=120)
+                        trials = client.suggest(q)
+                        X = np.asarray(
+                            [
+                                [t.params[f"x{j}"] for j in range(6)]
+                                for t in trials
+                            ],
+                            dtype=np.float32,
+                        )
+                        client.observe_all(
+                            trials, [float(v) for v in _hartmann6_np(X)]
+                        )
+                    reports[index] = audit_experiment(storage, experiment)
+                except Exception as exc:  # surfaced after join
+                    errors.append(exc)
+                    barrier.abort()
+
+            threads = [
+                threading.Thread(target=run_tenant, args=(i,), daemon=True)
+                for i in range(m_tenants)
+            ]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join(timeout=500)
+            assert not errors, f"serve bench tenant failed: {errors[0]!r}"
+            stats = server.stats_snapshot()
+            snapshot = tel.TELEMETRY.snapshot()
+    finally:
+        server.shutdown()
+        server.server_close()
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+
+    assert all(r.ok for r in reports.values()), {
+        i: r.summary() for i, r in reports.items() if not r.ok
+    }
+    assert stats["max_width"] >= 2, (
+        f"no coalescing happened: width stats {stats['widths']}"
+    )
+    ratio = stats["dispatches_per_suggest"]
+    assert ratio is not None and ratio < 1.0, (
+        f"device dispatches per suggest = {ratio} (must be < 1 for "
+        f"M={m_tenants} tenants): {stats}"
+    )
+    per_tenant = {}
+    for name, hist in (snapshot.get("histograms") or {}).items():
+        prefix, suffix = "serve.tenant.", ".request"
+        if name.startswith(prefix) and name.endswith(suffix) and hist.get("count"):
+            tenant = name[len(prefix):-len(suffix)]
+            per_tenant[tenant] = {
+                "requests": hist["count"],
+                "p50_ms": round(histogram_percentile(hist, 50) * 1e3, 3),
+                "p99_ms": round(histogram_percentile(hist, 99) * 1e3, 3),
+            }
+    return {
+        "tenants": m_tenants,
+        "rounds": rounds,
+        "q": q,
+        "suggests": stats["suggests"],
+        "device_dispatches": stats["dispatches"],
+        "dispatches_per_suggest": ratio,
+        "coalesced_dispatches": stats["coalesced_dispatches"],
+        "coalesce_max_width": stats["max_width"],
+        "coalesce_widths": stats["widths"],
+        "backpressure": stats["backpressure"],
+        "evictions": stats["evictions"],
+        "per_tenant": per_tenant,
+        "audit_violations": sum(
+            len(r.violations) for r in reports.values()
+        ),
+    }
+
+
+def main_serve(m_tenants=4, rounds=6, q=16):
+    """``bench.py --serve``: the gateway serving M concurrent experiments —
+    prints ONE json line with the coalesce/latency/dispatch-amortization
+    numbers (hard asserts inside bench_serve)."""
+    payload = {
+        "metric": "serve gateway smoke",
+        "serve": bench_serve(
+            m_tenants=m_tenants, rounds=rounds, q=q, n_candidates=1024,
+            fit_steps=8,
+        ),
+    }
+    print(json.dumps(payload))
+
+
 def bench_trace(out_path, rounds=3, q=16):
     """Run a few REAL producer rounds (sqlite storage, speculation-safe
     random search) and one GP suggest pair with the unified telemetry
@@ -848,6 +1003,12 @@ def main_smoke(trace_out="bench_trace.json"):
     gate = bench_regret_gate([list(c) for c in _baseline_curves()])
     gate["mode"] = "baseline-self"
     assert gate["pass"], f"committed regret baseline fails its own gate: {gate}"
+    # Tiny serve leg (orion_tpu.serve): 2 tenants, full producer stack over
+    # one in-process gateway — coalesce width >= 2, device dispatches per
+    # suggest < 1, and clean audits are hard-asserted inside.
+    serve_block = bench_serve(
+        m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=128, fit_steps=4
+    )
     trace_file = _safe_trace(trace_out)
     payload = _json_payload(
         metric=(
@@ -870,6 +1031,7 @@ def main_smoke(trace_out="bench_trace.json"):
     )
     payload["trace_file"] = trace_file
     payload["lint_violations"] = lint_violations
+    payload["serve"] = serve_block
     print(json.dumps(payload))
 
 
@@ -885,5 +1047,7 @@ if __name__ == "__main__":
         out = argv[at + 1]
     if "--chaos" in argv:
         main_chaos()
+    elif "--serve" in argv:
+        main_serve()
     else:
         main(smoke="--smoke" in argv, trace_out=out)

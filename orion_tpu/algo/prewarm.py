@@ -27,6 +27,11 @@ import logging
 import threading
 import time
 
+# Module-scope on purpose (cycle-free: history.py imports nothing from this
+# package): the bucket planners below AND the serve gateway's coalescer both
+# key on pow-2 buckets, and a per-call function-level import was pure
+# overhead once a second subsystem started planning buckets.
+from orion_tpu.algo.history import _next_pow2
 from orion_tpu.health import FLIGHT
 from orion_tpu.telemetry import TELEMETRY
 
@@ -64,7 +69,7 @@ def _note_prewarm_completed():
 
 
 def plan_next_bucket(count, *, floor, fill=DEFAULT_PREWARM_FILL, batch=0,
-                     next_pow2=None):
+                     next_pow2=_next_pow2):
     """The bucket worth prewarming for a history at ``count`` rows, or None.
 
     Two triggers, whichever fires first:
@@ -82,8 +87,6 @@ def plan_next_bucket(count, *, floor, fill=DEFAULT_PREWARM_FILL, batch=0,
     (full-history vs local-subset paths differ; a path whose fit shape is
     pinned, like the subset pad, has nothing to prewarm at history
     boundaries)."""
-    if next_pow2 is None:
-        from orion_tpu.algo.history import _next_pow2 as next_pow2
     if count <= 0:
         return None
     m = next_pow2(count, floor=floor)
@@ -107,8 +110,6 @@ def plan_fused_step_bucket(count, *, floor, fill=DEFAULT_PREWARM_FILL,
     pad is at most the current fit shape, which every suggest since the
     last boundary already compiled: warming it again would be a no-op
     that still books a ``jax.prewarms`` count."""
-    from orion_tpu.algo.history import _next_pow2
-
     if trust_region and tr_local_m is not None and count > tr_local_m:
         return None
     target = plan_next_bucket(count, floor=floor, fill=fill, batch=batch)

@@ -15,6 +15,7 @@ refits its posterior with fantasy rows instead of waiting on stragglers.
 
 import time
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +31,7 @@ from orion_tpu.algo.gp.acquisition import (
     joint_thompson,
     select_q,
 )
-from orion_tpu.algo.gp.gp import fit_gp, init_hypers, posterior_norm
+from orion_tpu.algo.gp.gp import GPHypers, fit_gp, init_hypers, posterior_norm
 from orion_tpu.algo.history import (
     DeviceHistory,
     HostHistory,
@@ -46,6 +47,16 @@ from orion_tpu.algo.prewarm import (
 )
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
 from orion_tpu.parallel import candidate_sharding, device_mesh
+
+
+class WarmStart(NamedTuple):
+    """Restored GP warm-start carrier: quacks like the slice of GPState the
+    suggest path reads before the first post-restore fit lands (``hypers``
+    for the refit init; ``mll``/``health`` absent)."""
+
+    hypers: "GPHypers"
+    mll: None = None
+    health: None = None
 
 
 def copula_transform(y):
@@ -398,17 +409,20 @@ class TPUBO(BaseAlgorithm):
     def _maybe_prewarm(self, batch=0):
         maybe_prewarm_fused_step(self, batch=batch)
 
-    def _suggest_cube(self, num):
+    def fused_step_plan(self, num):
+        """This round's fused suggest step as a :class:`FusedPlan`, or None
+        while the random-init phase is still running (nothing fused to
+        dispatch).  The plan is CONSUMING: it advances the RNG stream and
+        stamps the q bucket exactly as a direct suggest would, so a caller
+        holding a plan MUST run it (standalone via :func:`run_fused_plan`,
+        or stacked with other tenants' same-signature plans through the
+        serve gateway's coalescer) and feed the resulting GPState back via
+        :meth:`consume_fused_step` — which is precisely what
+        ``_suggest_cube`` does.  One prep path for both the standalone and
+        the coalesced dispatch is what makes them bit-identical."""
         n = self._host.count
         if n < self.n_init:
-            return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
-        # Single fused jit call: warm-started GP refit + on-device copula
-        # y-transform + candidate generation + acquisition + on-device
-        # dedup/EI-fill + gather.  One dispatch and one (q, d) transfer per
-        # suggest — dispatch latency otherwise dominates (each host->device
-        # round trip costs ~ms).  With a mesh, the same compiled step
-        # shards the candidate axis over it (SPMD collectives inserted by
-        # XLA, see orion_tpu.parallel).
+            return None
         self._last_q_bucket = _next_pow2(num, floor=8)
         center_idx = (
             self._tr_center
@@ -437,11 +451,29 @@ class TPUBO(BaseAlgorithm):
             # new observation) runs in-jit over the masked device y, so
             # nothing history-sized crosses the boundary here either.
             x_dev, y_dev, mask_dev, _ = self._hist.fit_view()
-        rows, state = run_suggest_step_arrays(
+        return make_fused_plan(
             self.next_key(), x_dev, y_dev, mask_dev, best_x,
-            self._gp_state, num, prewarmer=self._prewarmer, **step_kw,
+            self._gp_state, num, **step_kw,
         )
+
+    def consume_fused_step(self, state):
+        """Accept the GPState a fused-plan dispatch produced (warm-start
+        source for the next round's fit + packed device health)."""
         self._gp_state = state
+
+    def _suggest_cube(self, num):
+        plan = self.fused_step_plan(num)
+        if plan is None:
+            return jax.random.uniform(self.next_key(), (num, self.space.n_cols))
+        # Single fused jit call: warm-started GP refit + on-device copula
+        # y-transform + candidate generation + acquisition + on-device
+        # dedup/EI-fill + gather.  One dispatch and one (q, d) transfer per
+        # suggest — dispatch latency otherwise dominates (each host->device
+        # round trip costs ~ms).  With a mesh, the same compiled step
+        # shards the candidate axis over it (SPMD collectives inserted by
+        # XLA, see orion_tpu.parallel).
+        rows, state = run_fused_plan(plan, prewarmer=self._prewarmer)
+        self.consume_fused_step(state)
         return rows
 
     # --- health -------------------------------------------------------------
@@ -474,6 +506,18 @@ class TPUBO(BaseAlgorithm):
         out["y"] = self._y.tolist()
         out["tr"] = [self._tr_length, self._tr_succ, self._tr_fail]
         out["tr_center"] = self._tr_center
+        # GP warm start: the fitted hyperparameters the next round's refit
+        # resumes from.  Without them a restored instance cold-fits from
+        # init_hypers and the suggestion stream FORKS at the restore point
+        # — the serve gateway's --persist restart pins bit-identical
+        # continuation on exactly this field (tests/unit/test_serve.py).
+        if self._gp_state is not None:
+            hypers = self._gp_state.hypers
+            out["gp_hypers"] = [
+                np.asarray(hypers.log_lengthscales).tolist(),
+                float(hypers.log_amplitude),
+                float(hypers.log_noise),
+            ]
         return out
 
     def set_state(self, state):
@@ -486,7 +530,21 @@ class TPUBO(BaseAlgorithm):
         # resume from here.
         self._host = HostHistory.from_host(x, y)
         self._hist = DeviceHistory.from_host(x, y)
-        self._gp_state = None  # refit (cold) on the next suggest
+        saved = state.get("gp_hypers")
+        if saved is not None:
+            # Warm-restart shim: only .hypers feeds the next fused plan
+            # (the fit rebuilds chol/alpha on device); .health/.mll absent
+            # until the first restored round replaces this with a full
+            # GPState via consume_fused_step.
+            self._gp_state = WarmStart(
+                hypers=GPHypers(
+                    log_lengthscales=jnp.asarray(saved[0], jnp.float32),
+                    log_amplitude=jnp.asarray(saved[1], jnp.float32),
+                    log_noise=jnp.asarray(saved[2], jnp.float32),
+                )
+            )
+        else:
+            self._gp_state = None  # refit (cold) on the next suggest
         tr = state.get("tr")
         if tr is not None:
             self._tr_length, self._tr_succ, self._tr_fail = tr[0], int(tr[1]), int(tr[2])
@@ -894,6 +952,94 @@ def run_suggest_step(
     )
 
 
+class FusedPlan(NamedTuple):
+    """One prepared (not yet dispatched) fused suggest step.
+
+    ``arrays`` holds the traced inputs of ``_suggest_step`` in call order
+    (key, x, y, mask, best_x, warm hypers, tr_length); ``statics`` its
+    exact static-arg kwargs — warm-vs-cold fit_steps and the pow-2 q bucket
+    already folded in, so two plans with equal ``signature`` are guaranteed
+    to hit the SAME jit entry and can be stacked along a leading tenant
+    axis and dispatched as ONE device call (``orion_tpu.serve.coalesce``).
+    ``signature`` is that grouping key: buffer shapes + every static.
+    """
+
+    signature: tuple
+    arrays: tuple
+    statics: dict
+    num: int
+
+
+def make_fused_plan(
+    key,
+    x,
+    y,
+    mask,
+    best_x,
+    warm_state,
+    num,
+    *,
+    n_candidates,
+    kernel,
+    acq,
+    fit_steps,
+    refit_steps=None,
+    local_frac,
+    local_sigma,
+    beta,
+    trust_region=False,
+    tr_length=None,
+    tr_perturb_dims=20,
+    y_transform="none",
+    fixed_tail_cols=0,
+    mesh=None,
+):
+    """Fold the per-round dynamics (warm refit steps, q bucket, tr_length
+    boxing) into a :class:`FusedPlan`.  This is THE prep path — the
+    standalone dispatch (:func:`run_fused_plan`) and the gateway's
+    coalesced dispatch both consume plans built here, so their inputs
+    cannot drift."""
+    width = x.shape[1]
+    warm = warm_state.hypers if warm_state is not None else init_hypers(width)
+    if warm_state is not None and refit_steps is not None:
+        fit_steps = refit_steps
+    statics = dict(
+        q=_next_pow2(num, floor=8),
+        n_candidates=n_candidates,
+        kernel=kernel,
+        acq=acq,
+        fit_steps=fit_steps,
+        local_frac=local_frac,
+        local_sigma=local_sigma,
+        beta=beta,
+        trust_region=trust_region,
+        tr_perturb_dims=tr_perturb_dims,
+        y_transform=y_transform,
+        fixed_tail_cols=fixed_tail_cols,
+        mesh=mesh,
+    )
+    arrays = (
+        key,
+        x,
+        y,
+        mask,
+        jnp.asarray(best_x),
+        warm,
+        # Dynamic (traced) so success/failure box resizing never recompiles;
+        # always an array — jit caches on dtype, not value.
+        jnp.asarray(tr_length if tr_length is not None else 1.0, jnp.float32),
+    )
+    # The exact coalescing key (prewarm.start_bucket_prewarm builds its
+    # dedup key from the same statics): fit-buffer shape bucket + q bucket
+    # + every static arg.  Plans whose signatures match compile to the same
+    # jit entry, so stacking them is safe; anything else must not coalesce.
+    signature = (
+        tuple(x.shape),
+        tuple(sorted((k, str(v)) for k, v in statics.items())),
+    )
+    return FusedPlan(signature, arrays, statics, int(num))
+
+
 def run_suggest_step_arrays(
     key,
     x,
@@ -928,10 +1074,38 @@ def run_suggest_step_arrays(
     per round and each distinct q would otherwise recompile the whole
     graph).  Shared by ``tpu_bo`` and the multi-fidelity ``asha_bo``.
     """
-    width = x.shape[1]
-    warm = warm_state.hypers if warm_state is not None else init_hypers(width)
-    if warm_state is not None and refit_steps is not None:
-        fit_steps = refit_steps
+    plan = make_fused_plan(
+        key,
+        x,
+        y,
+        mask,
+        best_x,
+        warm_state,
+        num,
+        n_candidates=n_candidates,
+        kernel=kernel,
+        acq=acq,
+        fit_steps=fit_steps,
+        refit_steps=refit_steps,
+        local_frac=local_frac,
+        local_sigma=local_sigma,
+        beta=beta,
+        trust_region=trust_region,
+        tr_length=tr_length,
+        tr_perturb_dims=tr_perturb_dims,
+        y_transform=y_transform,
+        fixed_tail_cols=fixed_tail_cols,
+        mesh=mesh,
+    )
+    return run_fused_plan(plan, prewarmer=prewarmer)
+
+
+def run_fused_plan(plan, prewarmer=None):
+    """Dispatch ONE prepared :class:`FusedPlan` through the fused jit,
+    with the retrace-vs-cache-hit telemetry bracket.  Returns
+    ``(rows[:num], state)`` exactly as the pre-plan entry did."""
+    num = plan.num
+    x = plan.arrays[1]
     # Telemetry: jax dispatch is asynchronous, so this span is the HOST
     # cost of the fused step — tracing + lowering + compile on a cache
     # miss, ~argument-handling microseconds on a hit.  The jit cache size
@@ -958,30 +1132,7 @@ def run_suggest_step_arrays(
         )
         tel_prewarms_before = tel_completed()
         tel_t0 = time.perf_counter()
-    rows, state = _suggest_step(
-        key,
-        x,
-        y,
-        mask,
-        jnp.asarray(best_x),
-        warm,
-        # Dynamic (traced) so success/failure box resizing never recompiles;
-        # always an array — jit caches on dtype, not value.
-        jnp.asarray(tr_length if tr_length is not None else 1.0, jnp.float32),
-        q=_next_pow2(num, floor=8),
-        n_candidates=n_candidates,
-        kernel=kernel,
-        acq=acq,
-        fit_steps=fit_steps,
-        local_frac=local_frac,
-        local_sigma=local_sigma,
-        beta=beta,
-        trust_region=trust_region,
-        tr_perturb_dims=tr_perturb_dims,
-        y_transform=y_transform,
-        fixed_tail_cols=fixed_tail_cols,
-        mesh=mesh,
-    )
+    rows, state = _suggest_step(*plan.arrays, **plan.statics)
     if tel_t0 is not None:
         try:
             retraced = (

@@ -129,6 +129,27 @@ def _default_user():
         return os.environ.get("USER", "unknown")
 
 
+def build_all_experiments(args, view=True):
+    """``--all`` resolution shared by audit/top/info: the name-less
+    config/storage bootstrap, then EVERY experiment in the store built for
+    read-only inspection (a gateway hosts many tenants; fleet commands must
+    not require ``-n NAME`` per experiment).  Sorted by (name, version)."""
+    from orion_tpu.core.experiment import ExperimentView
+
+    config = load_cli_config(args)
+    storage = setup_storage(config["storage"], force=True)
+    docs = storage.fetch_experiments({})
+    experiments = []
+    for doc in sorted(docs, key=lambda d: (d["name"], d.get("version", 1))):
+        experiment = build_experiment(
+            storage, doc["name"], version=doc.get("version")
+        )
+        if view:
+            experiment = ExperimentView(experiment)
+        experiments.append(experiment)
+    return experiments
+
+
 def build_from_args(args, need_user_args=True, allow_create=True, view=False):
     """CLI args -> (experiment, cmdline_parser), with storage wired up.
 
@@ -244,6 +265,11 @@ def build_from_args(args, need_user_args=True, allow_create=True, view=False):
     experiment.max_idle_time = float(
         config.get("max_idle_time", experiment.max_idle_time)
     )
+    # Suggest-gateway selection is a worker-level knob too (the same
+    # experiment may run served on one box and local on another):
+    # instantiate() builds a RemoteAlgorithm when this is set.
+    if config.get("serve") is not None:
+        experiment.serve_config = config.get("serve")
     # Resuming: rebuild the parser from the stored experiment metadata so the
     # original template (and config file) is used even without user args.
     if not user_args:

@@ -7,7 +7,11 @@ stats.
 
 import time
 
-from orion_tpu.cli.base import add_experiment_args, build_from_args
+from orion_tpu.cli.base import (
+    add_experiment_args,
+    build_all_experiments,
+    build_from_args,
+)
 
 
 def add_subparser(subparsers):
@@ -19,6 +23,12 @@ def add_subparser(subparsers):
         help="show each worker's telemetry/health snapshot separately "
         "instead of only the merged view (MAX-merged gauges hide WHICH "
         "worker is lagging)",
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="show every experiment in the store (a serve gateway hosts "
+        "many tenants), not just -n NAME",
     )
     parser.set_defaults(func=main)
     return parser
@@ -206,6 +216,11 @@ def _health_section(experiment, per_worker=False):
                 ("q_unique_frac", ".2f"),
                 ("tr_length", ".3f"),
                 ("model_tier", "d"),
+                # Serve-gateway fields (orion_tpu.serve): the coalesce
+                # width this worker's rounds rode and the gateway queue.
+                ("serve_width", "d"),
+                ("serve_queue_depth", "d"),
+                ("serve_tenants", "d"),
             ):
                 value = doc.get(key)
                 if value is not None:
@@ -233,8 +248,17 @@ def _health_section(experiment, per_worker=False):
 
 
 def main(args):
+    per_worker = getattr(args, "per_worker", False)
+    if getattr(args, "all", False):
+        experiments = build_all_experiments(args)
+        if not experiments:
+            print("no experiments in storage")
+            return 0
+        for experiment in experiments:
+            print(format_info(experiment, per_worker=per_worker))
+        return 0
     experiment, _parser = build_from_args(
         args, need_user_args=False, allow_create=False, view=True
     )
-    print(format_info(experiment, per_worker=getattr(args, "per_worker", False)))
+    print(format_info(experiment, per_worker=per_worker))
     return 0

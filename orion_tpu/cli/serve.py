@@ -1,0 +1,90 @@
+"""`orion-tpu serve`: run the multi-tenant suggest gateway.
+
+No reference counterpart — part of the TPU build's serving subsystem
+(``orion_tpu.serve``).  One long-lived process owns the device and the
+algorithm instances for N experiments; workers point at it with
+``serve: {address: host:port}`` (or ``--serve-address`` equivalents in
+their config) and concurrent suggest traffic is coalesced into fused
+device dispatches.  See ``docs/serving.md`` for the protocol, coalescing
+semantics, and tenancy knobs.
+"""
+
+
+def add_subparser(subparsers):
+    parser = subparsers.add_parser(
+        "serve", help="run the multi-tenant suggest gateway"
+    )
+    parser.add_argument("--host", default="127.0.0.1", help="bind address")
+    parser.add_argument(
+        "--port", type=int, default=8777, help="bind port (default 8777)"
+    )
+    parser.add_argument(
+        "--window-ms",
+        type=float,
+        default=4.0,
+        metavar="ms",
+        help="coalescing window: how long the dispatcher waits after the "
+        "first queued suggest for more same-signature traffic (default 4ms)",
+    )
+    parser.add_argument(
+        "--max-width",
+        type=int,
+        default=8,
+        metavar="N",
+        help="widest single coalesced dispatch (tenant axis, pow-2 padded)",
+    )
+    parser.add_argument(
+        "--max-tenants",
+        type=int,
+        default=256,
+        metavar="N",
+        help="hosted-experiment cap; attaches beyond it evict the "
+        "least-recently-active idle tenant",
+    )
+    parser.add_argument(
+        "--max-inflight",
+        type=int,
+        default=4,
+        metavar="N",
+        help="per-tenant concurrent-suggest quota (excess gets RETRY-AFTER)",
+    )
+    parser.add_argument(
+        "--max-q",
+        type=int,
+        default=4096,
+        metavar="N",
+        help="per-tenant per-ask suggestion cap",
+    )
+    parser.add_argument(
+        "--pending-limit",
+        type=int,
+        default=256,
+        metavar="N",
+        help="bounded admission queue; a full queue answers RETRY-AFTER",
+    )
+    parser.add_argument(
+        "--persist",
+        default=None,
+        metavar="path",
+        help="snapshot tenant state (history, trust region, RNG stream) so "
+        "a restarted gateway resumes its tenants without client replay",
+    )
+    parser.set_defaults(func=main)
+    return parser
+
+
+def main(args):  # pragma: no cover - thin CLI shim over serve()
+    from orion_tpu.serve.gateway import serve
+
+    serve(
+        host=args.host,
+        port=args.port,
+        window=args.window_ms / 1e3,
+        max_width=args.max_width,
+        max_tenants=args.max_tenants,
+        max_inflight=args.max_inflight,
+        max_q=args.max_q,
+        pending_limit=args.pending_limit,
+        persist=args.persist,
+    )
+    return 0

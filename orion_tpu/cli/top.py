@@ -13,7 +13,11 @@ import json
 import sys
 import time
 
-from orion_tpu.cli.base import add_experiment_args, build_from_args
+from orion_tpu.cli.base import (
+    add_experiment_args,
+    build_all_experiments,
+    build_from_args,
+)
 
 SPARK_CHARS = "▁▂▃▄▅▆▇█"
 
@@ -42,6 +46,12 @@ def add_subparser(subparsers):
         default=0,
         metavar="N",
         help="render N frames then exit (default 0 = until interrupted)",
+    )
+    parser.add_argument(
+        "--all",
+        action="store_true",
+        help="dashboard every experiment in the store (a serve gateway "
+        "hosts many tenants), not just -n NAME",
     )
     parser.set_defaults(func=main)
     return parser
@@ -173,6 +183,12 @@ def snapshot_top(experiment, now=None):
                 "rung_occupancy",
                 "model_tier",
                 "algo",
+                # Serve-gateway fields (orion_tpu.serve): rounds produced
+                # through a gateway report their coalesce width and the
+                # gateway queue depth alongside the algorithm health.
+                "serve_width",
+                "serve_queue_depth",
+                "serve_tenants",
             )
             if latest.get(key) is not None
         }
@@ -241,19 +257,63 @@ def render_top(snap):
     return "\n".join(lines)
 
 
-def main(args):
-    experiment, _parser = build_from_args(
-        args, need_user_args=False, allow_create=False, view=True
+def render_fleet(snaps):
+    """The ``--all`` frame: one row per experiment — the operator's view of
+    a gateway hosting many tenants (who is producing, who is stalled, where
+    the fleet incumbents sit) without running N ``top`` processes."""
+    header = (
+        f"{'experiment':<28} {'workers':>7} {'records':>7} {'rounds':>6} "
+        f"{'best_y':>12} {'retry':>5} {'reconn':>6}"
     )
+    lines = [
+        f"orion-tpu top --all   experiments: {len(snaps)}",
+        "",
+        header,
+        "-" * len(header),
+    ]
+    for snap in snaps:
+        rounds = sum(row["rounds"] for row in snap["workers"].values())
+        retries = sum(row["retries"] for row in snap["workers"].values())
+        reconnects = sum(
+            row["reconnects"] for row in snap["workers"].values()
+        )
+        best = snap["incumbent"]["best_y"]
+        lines.append(
+            f"{snap['experiment'] + ' v' + str(snap['version']):<28} "
+            f"{len(snap['workers']):>7} {snap['health_records']:>7} "
+            f"{rounds:>6} "
+            f"{format(best, '12.5g') if best is not None else '-':>12} "
+            f"{retries:>5} {reconnects:>6}"
+        )
+    if not snaps:
+        lines.append("(no experiments in storage)")
+    return "\n".join(lines)
+
+
+def main(args):
+    if getattr(args, "all", False):
+        # Re-resolved EVERY frame: a fleet dashboard watching a gateway
+        # must pick up experiments attached after it started.
+        snapshot = lambda: [  # noqa: E731
+            snapshot_top(e) for e in build_all_experiments(args)
+        ]
+        render = render_fleet
+        as_json = lambda snaps: {"experiments": snaps}  # noqa: E731
+    else:
+        experiment, _parser = build_from_args(
+            args, need_user_args=False, allow_create=False, view=True
+        )
+        snapshot = lambda: snapshot_top(experiment)  # noqa: E731
+        render = render_top
+        as_json = lambda snap: snap  # noqa: E731
     if args.json:
-        print(json.dumps(snapshot_top(experiment)))
+        print(json.dumps(as_json(snapshot())))
         return 0
     frames = 0
     try:
         while True:
-            snap = snapshot_top(experiment)
             # ANSI clear + home, one frame per interval.
-            sys.stdout.write("\x1b[2J\x1b[H" + render_top(snap) + "\n")
+            sys.stdout.write("\x1b[2J\x1b[H" + render(snapshot()) + "\n")
             sys.stdout.flush()
             frames += 1
             if args.iterations and frames >= args.iterations:

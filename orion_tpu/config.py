@@ -91,6 +91,14 @@ DEFAULTS = {
     # process-wide registry as the ORION_TPU_TELEMETRY env var set it;
     # true/false here overrides (the CLI applies it in load_cli_config).
     "telemetry": None,
+    # Suggest gateway (orion_tpu.serve, docs/serving.md): a worker-level
+    # knob, never part of the stored experiment identity.  None = local
+    # algorithm instance (the default); {"address": "host:port", optional
+    # "retry": {...}, "quotas": {"max_inflight": n, "max_q": n},
+    # "timeout": s} = drive this experiment's suggest/observe through the
+    # shared gateway (the ORION_SERVE_ADDRESS env var sets the address
+    # alone).
+    "serve": None,
 }
 
 
@@ -114,6 +122,9 @@ def _env_config():
             storage["path"] = address
     if storage:
         out["storage"] = storage
+    serve_address = os.getenv("ORION_SERVE_ADDRESS")
+    if serve_address:
+        out["serve"] = {"address": serve_address}
     # Explicit coercions — the DEFAULTS values are None, so their type can't
     # be used to coerce, and a string max_trials would poison comparisons.
     for key, cast in (("max_trials", float), ("pool_size", int), ("max_broken", int)):

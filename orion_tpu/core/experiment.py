@@ -54,14 +54,48 @@ class Experiment:
         self.space = build_space(self.priors) if self.priors else None
         self.algorithm = None
         self.strategy = None
+        # Worker-level serving knob (never stored identity): a ``serve:``
+        # config section — {"address": "host:port", ...} — makes
+        # instantiate() build a gateway-backed RemoteAlgorithm instead of
+        # a local instance (orion_tpu.serve, docs/serving.md).  Set by the
+        # CLI bootstrap next to heartbeat/max_idle_time.
+        self.serve_config = config.get("serve")
 
     # --- instantiation ------------------------------------------------------
     def instantiate(self, seed=None):
         """Build the algorithm + strategy from config (reference
-        `experiment.py:562-614`)."""
+        `experiment.py:562-614`).
+
+        With a ``serve_config`` attached, the algorithm is a
+        :class:`~orion_tpu.serve.client.RemoteAlgorithm` driving a tenant
+        on the shared suggest gateway — same ``BaseAlgorithm`` surface, so
+        the producer/worker stack is untouched.  The tenant is keyed by
+        (name, version, host:pid) — one gateway-side instance PER WORKER,
+        exactly mirroring local semantics: each worker's producer observes
+        the full completed history from storage into its own instance, so
+        worker restarts and multi-worker experiments never double-feed a
+        shared model (coalescing still amortizes across workers AND
+        experiments — signatures, not tenants, group dispatches; a dead
+        worker's tenant ages out via the gateway's idle eviction)."""
         if self.space is None:
             raise ValueError(f"Experiment {self.name} has no search space")
-        self.algorithm = create_algo(self.space, self.algo_config, seed=seed)
+        if self.serve_config:
+            import os
+            import socket
+
+            from orion_tpu.serve.client import connect_remote_algorithm
+
+            worker = f"{socket.gethostname()}:{os.getpid()}"
+            self.algorithm = connect_remote_algorithm(
+                self.space,
+                self.priors,
+                self.algo_config,
+                self.serve_config,
+                tenant=f"{self.name}-v{self.version}@{worker}",
+                seed=seed,
+            )
+        else:
+            self.algorithm = create_algo(self.space, self.algo_config, seed=seed)
         self.strategy = create_strategy(self.strategy_config)
         return self
 

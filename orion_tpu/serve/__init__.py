@@ -1,0 +1,25 @@
+"""Multi-tenant suggest gateway: one device, thousands of live experiments.
+
+ROADMAP item 4 — the serving front over the columnar suggest/observe
+boundary (PR 1), the one-round-trip wire discipline (PR 2), and the pow-2
+bucket machinery (PR 4).  A long-lived :class:`GatewayServer` owns the
+device and the algorithm instances for N experiments; workers talk to it
+through :class:`GatewayClient` / :class:`RemoteAlgorithm` (the
+``BaseAlgorithm`` adapter the producer drives transparently via the
+``serve: {address: ...}`` config), and concurrent suggest traffic from
+tenants sharing a fused-step signature is stacked along a leading tenant
+axis and dispatched as ONE device call (``orion_tpu.serve.coalesce``),
+bit-identical per tenant to a standalone run.  See ``docs/serving.md``.
+"""
+
+from orion_tpu.serve.client import (  # noqa: F401
+    GatewayClient,
+    RemoteAlgorithm,
+    connect_remote_algorithm,
+)
+from orion_tpu.serve.gateway import GatewayServer  # noqa: F401
+from orion_tpu.serve.protocol import (  # noqa: F401
+    GatewayError,
+    RetryAfterError,
+    UnknownTenantError,
+)

@@ -1,0 +1,114 @@
+"""Cross-tenant coalescing of fused suggest steps.
+
+Tenants whose :class:`~orion_tpu.algo.tpu_bo.FusedPlan` signatures match
+(same fit-buffer pow-2 bucket, same q bucket, same static args — exactly
+the key ``prewarm.plan_fused_step_bucket``'s machinery buckets on) are
+stacked along a leading tenant axis and dispatched as ONE device call.
+
+**Bit-identity is the design constraint**, not a nice-to-have: a tenant
+must get the same suggestion stream whether it is served alone or coalesced
+with strangers, or the gateway silently changes every hosted experiment's
+trajectory.  The stacked step therefore runs ``jax.lax.map`` (a scan whose
+body is the SAME per-element computation graph as the standalone jitted
+call, each lane independent) — ``jax.vmap`` is deliberately NOT used
+because batched linalg primitives (matmul/Cholesky over a batch axis) may
+lower to different reduction orders and break float equality; measured on
+CPU: ``lax.map`` is bit-identical to the standalone call, ``vmap`` is not.
+The differential test (``tests/unit/test_serve.py``) pins this.
+
+The tenant axis is padded to a pow-2 bucket (lane 0 repeated) so the
+stacked jit compiles once per ``(t_pad, signature)`` instead of once per
+group width — and :func:`prewarm_stacked` hands the NEXT width bucket's
+compile to a :class:`~orion_tpu.algo.prewarm.BucketPrewarmer` so growing
+coalesce widths hit the cache, the same discipline PR 4 built for history
+buckets.  Padding lanes are discarded un-read; their computation cannot
+influence real lanes (scan lanes are independent).
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from orion_tpu.algo.history import _next_pow2
+from orion_tpu.algo.tpu_bo import _suggest_step
+
+#: Static-arg names of the stacked step — exactly ``_suggest_step``'s, so a
+#: FusedPlan's ``statics`` dict splats into either entry unchanged.
+_STACK_STATICS = (
+    "q",
+    "n_candidates",
+    "kernel",
+    "acq",
+    "fit_steps",
+    "local_frac",
+    "local_sigma",
+    "beta",
+    "trust_region",
+    "tr_perturb_dims",
+    "y_transform",
+    "fixed_tail_cols",
+    "mesh",
+)
+
+
+@partial(jax.jit, static_argnames=_STACK_STATICS)
+def _stacked_suggest_step(stacked, **statics):
+    """T same-signature fused steps as ONE compiled computation.
+
+    ``stacked`` is the tuple of ``_suggest_step``'s traced args, each with
+    a leading tenant axis.  ``lax.map`` keeps every lane's computation
+    graph identical to the standalone call — the bit-identity contract."""
+    return jax.lax.map(lambda args: _suggest_step(*args, **statics), stacked)
+
+
+def stack_plans(plans, t_pad=None):
+    """Stack same-signature plans' input arrays along a new leading tenant
+    axis, padded to ``t_pad`` (default: the pow-2 bucket of ``len(plans)``)
+    by repeating lane 0 — padding lanes compile-shape filler only."""
+    t_pad = t_pad or _next_pow2(len(plans), floor=1)
+    lanes = [p.arrays for p in plans]
+    lanes += [plans[0].arrays] * (t_pad - len(lanes))
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *lanes)
+
+
+def run_coalesced_plans(plans, t_pad=None):
+    """Dispatch same-signature :class:`FusedPlan`s as ONE device call.
+
+    Returns ``[(rows, state), ...]`` aligned with ``plans`` — each entry
+    exactly what :func:`~orion_tpu.algo.tpu_bo.run_fused_plan` would have
+    returned for that plan alone (rows sliced to the plan's ``num``, the
+    lane's GPState ready for ``consume_fused_step``).
+    """
+    signature = plans[0].signature
+    for plan in plans[1:]:
+        if plan.signature != signature:
+            raise ValueError(
+                "cannot coalesce plans with differing fused-step signatures"
+            )
+    stacked = stack_plans(plans, t_pad=t_pad)
+    rows, states = _stacked_suggest_step(stacked, **plans[0].statics)
+    out = []
+    for lane, plan in enumerate(plans):
+        lane_state = jax.tree.map(lambda leaf, lane=lane: leaf[lane], states)
+        out.append((rows[lane][: plan.num], lane_state))
+    return out
+
+
+def prewarm_stacked(sample_plan, t_pad):
+    """Zero-dummy compile closure for the stacked step at tenant-axis
+    bucket ``t_pad`` and ``sample_plan``'s signature — hand it to a
+    :class:`~orion_tpu.algo.prewarm.BucketPrewarmer` keyed by
+    ``("stacked", t_pad) + sample_plan.signature`` so a growing coalesce
+    width crosses its pow-2 bucket on a jit-cache hit, never a synchronous
+    stall in the middle of a dispatch cycle."""
+    dummies = jax.tree.map(
+        lambda leaf: jnp.zeros((t_pad,) + leaf.shape, leaf.dtype),
+        sample_plan.arrays,
+    )
+    statics = dict(sample_plan.statics)
+
+    def compile_fn():
+        _stacked_suggest_step(dummies, **statics)
+
+    return compile_fn

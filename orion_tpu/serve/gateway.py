@@ -1,0 +1,919 @@
+"""The suggest gateway: one long-lived process, one device, N experiments.
+
+A :class:`GatewayServer` owns the algorithm instances for every attached
+tenant and runs a **coalescing dispatcher**: suggest requests arriving
+within a small window (or already queued) whose fused-step signatures
+match are stacked along the tenant axis and dispatched as ONE device call
+(``orion_tpu.serve.coalesce``), then demultiplexed back to per-tenant
+replies — host orchestration and device dispatch are amortized across
+tenants instead of being paid per experiment (ROADMAP items 2 and 4).
+
+Discipline reused from ``storage/netdb.py``'s server: a
+``ThreadingTCPServer`` whose handler threads speak the newline-framed JSON
+wire (one request line, one reply line, torn lines dropped), plus an
+optional rate-limited persist snapshot (atomic tempfile + rename) so a
+restarted gateway resumes its tenants — here the snapshot is the tenants'
+``state_dict``s, which restore history, trust-region box AND the RNG
+stream, so persisted restarts keep suggestion streams intact.  Without
+persist, a restart surfaces as ``UnknownTenant`` and the client-side
+adapter re-attaches and replays.
+
+**Tenancy**: per-tenant quotas (``max_inflight`` concurrent suggests,
+``max_q`` rows per ask), fair-share interleaving inside the coalescer
+(round-robin across tenants, so one chatty tenant cannot monopolize a
+dispatch), and backpressure — a bounded admission queue and quota refusals
+answer with a structured RETRY-AFTER reply the client's retry policy backs
+off on.  Tenant eviction (LRU-idle, on attach overflow) and backpressure
+are flight-recorder events.
+
+**Observability**: ``serve.*`` counters/gauges/histograms through the
+process-wide telemetry registry (``serve.coalesce.width``,
+``serve.queue_depth``, per-tenant request latency histograms), and every
+suggest reply carries a health record (tenant algorithm health + serve
+fields) the client-side adapter hands to its producer's health channel —
+gateway rounds thereby show up in ``orion-tpu top``/``info`` with no
+storage access from the gateway itself.
+"""
+
+import copy
+import logging
+import os
+import pickle
+import queue
+import socketserver
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from orion_tpu.algo.base import BaseAlgorithm, create_algo
+from orion_tpu.algo.history import _next_pow2
+from orion_tpu.algo.prewarm import BucketPrewarmer
+from orion_tpu.algo.tpu_bo import run_fused_plan
+from orion_tpu.health import FLIGHT
+from orion_tpu.serve.coalesce import prewarm_stacked, run_coalesced_plans
+from orion_tpu.serve.protocol import (
+    GATEWAY_OPS,
+    GatewayError,
+    dumps_line,
+    error_reply,
+    ok_reply,
+    read_line,
+)
+from orion_tpu.space.dsl import build_space
+from orion_tpu.storage.backends import atomic_pickle_dump
+from orion_tpu.telemetry import TELEMETRY
+
+log = logging.getLogger(__name__)
+
+#: Per-tenant ledgers are bounded: applied-id memory (observe/register
+#: dedup) and the suggest reply cache only need to cover the client's
+#: retry horizon, not the experiment's lifetime.
+APPLIED_IDS_CAP = 4096
+REPLY_CACHE_CAP = 32
+
+
+class _Tenant:
+    """One hosted experiment: its algorithm, quotas, ledgers, counters."""
+
+    def __init__(self, name, space, priors, algo_config, seed, algo,
+                 max_inflight, max_q):
+        self.name = name
+        self.space = space
+        self.priors = dict(priors)
+        self.algo_config = algo_config
+        self.seed = seed
+        self.algo = algo
+        self.max_inflight = max_inflight
+        self.max_q = max_q
+        self.created_at = time.time()
+        self.last_active = time.monotonic()
+        self.inflight = 0  # mutated under the gateway lock only
+        self.naive_algo = None
+        self.naive_epoch = None
+        self.reply_cache = OrderedDict()
+        self.applied_ids = set()
+        self.applied_order = deque()
+        self.suggests = 0
+        self.observes = 0
+        # Computed ONCE so the per-request hot path books its latency
+        # histogram without building a metric name per call.
+        self.metric_request = f"serve.tenant.{name}.request"
+        # Whether register_suggestion forwarding is worth the wire bytes:
+        # only algorithms that actually override the hook want it.
+        self.wants_register = (
+            type(algo).register_suggestion
+            is not BaseAlgorithm.register_suggestion
+        )
+
+    def remember_applied(self, applied_id):
+        self.applied_ids.add(applied_id)
+        self.applied_order.append(applied_id)
+        while len(self.applied_order) > APPLIED_IDS_CAP:
+            self.applied_ids.discard(self.applied_order.popleft())
+
+    def cache_reply(self, req_id, reply):
+        if not req_id:
+            return
+        self.reply_cache[req_id] = reply
+        while len(self.reply_cache) > REPLY_CACHE_CAP:
+            self.reply_cache.popitem(last=False)
+
+    def state_snapshot(self):
+        """Persistable description (config + ``state_dict``): restoring it
+        rebuilds the algorithm with history, box and RNG stream intact.
+        The applied-id ledger rides along — a client replaying its log
+        against a restored-but-stale tenant must have the already-
+        snapshotted batches dedup, not double-observe."""
+        return {
+            "priors": dict(self.priors),
+            "algo_config": self.algo_config,
+            "seed": self.seed,
+            "max_inflight": self.max_inflight,
+            "max_q": self.max_q,
+            "state": self.algo.state_dict(),
+            "applied_ids": list(self.applied_order),
+        }
+
+
+class _WorkItem:
+    """One queued request: payload in, reply out, a handler thread parked
+    on ``done`` in between."""
+
+    __slots__ = ("op", "tenant_name", "payload", "reply", "done", "counted",
+                 "enqueued_at")
+
+    def __init__(self, op, payload):
+        self.op = op
+        self.tenant_name = str(payload.get("tenant") or "")
+        self.payload = payload
+        self.reply = None
+        self.done = threading.Event()
+        self.counted = False  # holds an inflight-quota slot
+        self.enqueued_at = time.perf_counter()
+
+
+#: Sentinel reply meaning "hang up instead of answering": a stopping
+#: gateway must CLOSE the connection, not send an error — the client's
+#: reconnect then lands on whatever replaced this gateway on the address
+#: (the restart-transparency contract).
+_CLOSE = object()
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        while True:
+            try:
+                request = read_line(self.rfile)
+            except (ValueError, OSError) as exc:
+                log.warning(
+                    "bad gateway request from %s: %s", self.client_address, exc
+                )
+                return
+            if request is None:
+                return
+            reply = self.server.handle_request(request)
+            if reply is _CLOSE:
+                return
+            self.wfile.write(dumps_line(reply))
+
+
+class GatewayServer(socketserver.ThreadingTCPServer):
+    """Serve suggest/observe traffic for many experiments over one device.
+
+    Knobs (constructor args = `orion-tpu serve` flags = ``serve:`` config):
+
+    - ``window``: seconds the dispatcher waits after the first queued
+      suggest for more same-signature traffic to coalesce with;
+    - ``max_width``: widest single coalesced dispatch (the tenant axis is
+      pow-2 padded, so widths compile per bucket, not per count);
+    - ``max_tenants`` / ``max_inflight`` / ``max_q`` / ``pending_limit``:
+      the tenancy quotas (see module docstring);
+    - ``persist`` / ``persist_interval``: optional tenant-state snapshot.
+    """
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(
+        self,
+        host="127.0.0.1",
+        port=0,
+        window=0.004,
+        max_width=8,
+        max_tenants=256,
+        max_inflight=4,
+        max_q=4096,
+        pending_limit=256,
+        request_timeout=120.0,
+        persist=None,
+        persist_interval=5.0,
+    ):
+        self.window = float(window)
+        self.max_width = max(1, int(max_width))
+        self.max_tenants = int(max_tenants)
+        self.max_inflight = int(max_inflight)
+        self.max_q = int(max_q)
+        self.pending_limit = int(pending_limit)
+        self.request_timeout = float(request_timeout)
+        self.persist = persist
+        self.persist_interval = float(persist_interval)
+        self._tenants = {}
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+        self._stop = threading.Event()
+        self._dirty = False  # persist snapshot pending (dispatcher-owned)
+        self._last_persist = 0.0
+        self._prewarmer = BucketPrewarmer()
+        self._stats = {
+            "suggests": 0,
+            "observes": 0,
+            "dispatches": 0,
+            "coalesced_dispatches": 0,
+            "coalesced_suggests": 0,
+            "backpressure": 0,
+            "evictions": 0,
+            "max_width": 0,
+            "widths": {},
+        }
+        if persist and os.path.exists(persist):
+            self._restore(persist)
+        super().__init__((host, int(port)), _Handler)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="orion-tpu-gateway", daemon=True
+        )
+        self._dispatcher.start()
+
+    # --- lifecycle -----------------------------------------------------------
+    @property
+    def address(self):
+        return self.server_address[:2]
+
+    def serve_background(self):
+        """Start accepting on a daemon thread; returns (host, port)."""
+        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        thread.start()
+        return self.address
+
+    def shutdown(self):
+        self._stop.set()
+        super().shutdown()
+        self._dispatcher.join(timeout=5.0)
+        # Final durable snapshot — same exit discipline as DBServer.
+        if self.persist and self._dirty:
+            self._write_snapshot()
+
+    def _restore(self, path):
+        try:
+            with open(path, "rb") as handle:
+                snapshot = pickle.load(handle)
+        except Exception:
+            log.exception("could not restore gateway snapshot %s", path)
+            return
+        for name, saved in (snapshot.get("tenants") or {}).items():
+            try:
+                space = build_space(saved["priors"])
+                algo = create_algo(
+                    space, saved["algo_config"], seed=saved.get("seed")
+                )
+                algo.set_state(saved["state"])
+                tenant = _Tenant(
+                    name,
+                    space,
+                    saved["priors"],
+                    saved["algo_config"],
+                    saved.get("seed"),
+                    algo,
+                    saved.get("max_inflight", self.max_inflight),
+                    saved.get("max_q", self.max_q),
+                )
+                for applied_id in saved.get("applied_ids") or ():
+                    tenant.remember_applied(applied_id)
+                # _restore runs from __init__ (pre-thread), but tenant-map
+                # writes stay under the lock everywhere for one invariant.
+                with self._lock:
+                    self._tenants[name] = tenant
+            except Exception:
+                log.exception("could not restore tenant %r", name)
+        if self._tenants:
+            log.info(
+                "gateway restored %d tenant(s) from %s", len(self._tenants),
+                path,
+            )
+
+    def _write_snapshot(self):
+        """Dispatcher-thread-only: the algorithms are single-threaded state,
+        so the snapshot dict is built here and written atomically (the rate
+        limit keeps the O(history) ``state_dict`` walk off every round)."""
+        snapshot = {
+            "tenants": {
+                name: tenant.state_snapshot()
+                for name, tenant in self._tenants.items()
+            }
+        }
+        atomic_pickle_dump(self.persist, snapshot)
+        self._dirty = False
+        self._last_persist = time.monotonic()
+
+    def _maybe_persist(self):
+        if not (self.persist and self._dirty):
+            return
+        if time.monotonic() - self._last_persist < self.persist_interval:
+            return
+        self._write_snapshot()
+
+    # --- request admission (handler threads) ---------------------------------
+    def handle_request(self, request):
+        if self._stop.is_set():
+            # A stopping gateway hangs up rather than queueing work its
+            # dispatcher will never run — the client reconnects and finds
+            # the restarted gateway on this address.
+            return _CLOSE
+        op = request.get("op")
+        if op not in GATEWAY_OPS:
+            return error_reply("GatewayError", f"bad op {op!r}")
+        if op == "ping":
+            return ok_reply("pong")
+        if op == "stats":
+            return ok_reply(self.stats_snapshot())
+        item = _WorkItem(op, request)
+        refused = self._admit(item)
+        if refused is not None:
+            return refused
+        if not item.done.wait(self.request_timeout):
+            # A backlog the dispatcher could not drain in time is OVERLOAD,
+            # not a protocol failure: answer transiently (RetryAfter) so
+            # the client backs off instead of crashing its worker.  The
+            # orphaned item still executes when the dispatcher reaches it
+            # — safe by the same id-dedup contracts a lost reply rides:
+            # the re-asked suggest hits the req_id reply cache, a re-sent
+            # observe/register dedups on its minted id.
+            return self._retry_after_reply(
+                f"gateway did not answer {op!r} within "
+                f"{self.request_timeout}s (dispatcher backlog)"
+            )
+        return item.reply
+
+    def _retry_after_reply(self, message):
+        delay = round(max(4 * self.window, 0.02), 3)
+        if FLIGHT.enabled:
+            FLIGHT.record("serve.backpressure", args={"message": message})
+        TELEMETRY.count("serve.backpressure")
+        return error_reply(
+            "RetryAfter", message, retry_after=delay
+        )
+
+    def _admit(self, item):
+        """Admission control, under the gateway lock: bounded queue +
+        per-tenant inflight quota.  Returns a refusal reply, or None when
+        the item was queued."""
+        with self._lock:
+            if self._queue.qsize() >= self.pending_limit:
+                self._stats["backpressure"] += 1
+                refused = True
+                message = (
+                    f"gateway queue full ({self.pending_limit} pending)"
+                )
+            else:
+                refused = False
+                if item.op == "suggest":
+                    tenant = self._tenants.get(item.tenant_name)
+                    if tenant is not None:
+                        if tenant.inflight >= tenant.max_inflight:
+                            self._stats["backpressure"] += 1
+                            refused = True
+                            message = (
+                                f"tenant {item.tenant_name!r} already has "
+                                f"{tenant.inflight} suggest(s) in flight"
+                            )
+                        else:
+                            tenant.inflight += 1
+                            item.counted = True
+                if not refused:
+                    self._queue.put(item)
+        if refused:
+            return self._retry_after_reply(message)
+        return None
+
+    # --- the coalescing dispatcher -------------------------------------------
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if self._stop.is_set():
+                self._queue.put(first)
+                break
+            batch = [first]
+            while True:  # opportunistic drain of everything already queued
+                try:
+                    batch.append(self._queue.get_nowait())
+                except queue.Empty:
+                    break
+            if any(item.op == "suggest" for item in batch):
+                # Coalescing window: wait a beat for other tenants' suggest
+                # traffic to arrive so it can ride THIS device dispatch.
+                deadline = time.monotonic() + self.window
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    try:
+                        batch.append(self._queue.get(timeout=remaining))
+                    except queue.Empty:
+                        break
+            TELEMETRY.set_gauge("serve.queue_depth", self._queue.qsize())
+            try:
+                self._process(batch)
+            except Exception:  # pragma: no cover - per-item paths catch first
+                log.exception("gateway dispatch cycle failed")
+                for item in batch:
+                    if not item.done.is_set():
+                        self._finish(
+                            item,
+                            error_reply(
+                                "GatewayError", "internal dispatch failure"
+                            ),
+                        )
+            self._maybe_persist()
+        # Stopping: anything still queued gets the hang-up sentinel so its
+        # handler closes the connection and the client re-asks elsewhere.
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self._finish(item, _CLOSE)
+
+    def _finish(self, item, reply):
+        if item.counted:
+            with self._lock:
+                tenant = self._tenants.get(item.tenant_name)
+                if tenant is not None:
+                    tenant.inflight = max(0, tenant.inflight - 1)
+            item.counted = False
+        item.reply = reply
+        item.done.set()
+        TELEMETRY.observe(
+            "serve.request", time.perf_counter() - item.enqueued_at
+        )
+
+    def _process(self, batch):
+        suggests = []
+        for item in batch:
+            if item.op == "suggest":
+                suggests.append(item)
+                continue
+            try:
+                reply = self._apply(item)
+            except GatewayError as exc:
+                reply = error_reply(type(exc).__name__, str(exc))
+            except Exception as exc:
+                log.exception("gateway op %r failed", item.op)
+                reply = error_reply(type(exc).__name__, str(exc))
+            self._finish(item, reply)
+        if suggests:
+            self._run_suggests(suggests)
+
+    # --- non-suggest ops ------------------------------------------------------
+    def _apply(self, item):
+        payload = item.payload
+        if item.op == "attach":
+            return self._attach(payload)
+        if item.op == "detach":
+            with self._lock:
+                self._tenants.pop(item.tenant_name, None)
+            self._dirty = True
+            return ok_reply({"detached": True})
+        tenant = self._tenants.get(item.tenant_name)
+        if tenant is None:
+            return error_reply(
+                "UnknownTenant", f"no tenant {item.tenant_name!r} attached"
+            )
+        tenant.last_active = time.monotonic()
+        if item.op == "observe":
+            return self._observe(tenant, payload)
+        if item.op == "register":
+            return self._register(tenant, payload)
+        return error_reply("GatewayError", f"bad op {item.op!r}")
+
+    def _attach(self, payload):
+        name = str(payload.get("tenant") or "")
+        if not name:
+            return error_reply("GatewayError", "attach requires a tenant name")
+        tenant = self._tenants.get(name)
+        if tenant is not None:
+            tenant.last_active = time.monotonic()
+            return ok_reply(
+                {
+                    "created": False,
+                    "n_observed": int(tenant.algo.n_observed),
+                    "wants_register": tenant.wants_register,
+                }
+            )
+        if len(self._tenants) >= self.max_tenants:
+            evicted = self._evict_idle()
+            if not evicted:
+                return self._retry_after_reply(
+                    f"gateway at max_tenants={self.max_tenants} with every "
+                    "tenant busy"
+                )
+        priors = dict(payload.get("priors") or {})
+        if not priors:
+            return error_reply("GatewayError", "attach requires priors")
+        quotas = dict(payload.get("quotas") or {})
+        space = build_space(priors)
+        algo = create_algo(space, payload.get("algo"), seed=payload.get("seed"))
+        tenant = _Tenant(
+            name,
+            space,
+            priors,
+            payload.get("algo"),
+            payload.get("seed"),
+            algo,
+            # Client quotas may only tighten the server caps, never raise
+            # them — the caps are the operator's protection.
+            min(self.max_inflight, int(quotas.get("max_inflight") or self.max_inflight)),
+            min(self.max_q, int(quotas.get("max_q") or self.max_q)),
+        )
+        with self._lock:
+            self._tenants[name] = tenant
+        self._dirty = True
+        TELEMETRY.count("serve.attaches")
+        log.info("gateway attached tenant %r (%s)", name, payload.get("algo"))
+        return ok_reply(
+            {
+                "created": True,
+                "n_observed": 0,
+                "wants_register": tenant.wants_register,
+            }
+        )
+
+    def _evict_idle(self):
+        """Drop the least-recently-active tenant with nothing in flight.
+        Its durable truth lives in the experiment's storage and the
+        client-side replay log — eviction costs a re-attach + replay, not
+        data."""
+        with self._lock:
+            idle = [t for t in self._tenants.values() if t.inflight == 0]
+            if not idle:
+                return None
+            victim = min(idle, key=lambda t: t.last_active)
+            del self._tenants[victim.name]
+            self._stats["evictions"] += 1
+        self._dirty = True
+        TELEMETRY.count("serve.evictions")
+        if FLIGHT.enabled:
+            FLIGHT.record("serve.evict", args={"tenant": victim.name})
+        log.info("gateway evicted idle tenant %r", victim.name)
+        return victim
+
+    def _observe(self, tenant, payload):
+        obs_id = payload.get("obs_id")
+        if obs_id is not None and obs_id in tenant.applied_ids:
+            # Applied-and-reply-lost resend: ack without re-feeding the
+            # algorithm — THE convergence contract mode="always" rides on.
+            return ok_reply(
+                {"applied": False, "n_observed": int(tenant.algo.n_observed)}
+            )
+        params = payload.get("params") or []
+        objectives = payload.get("objectives") or []
+        if len(params) != len(objectives):
+            raise GatewayError(
+                f"observe carries {len(params)} params for "
+                f"{len(objectives)} objectives"
+            )
+        results = [{"objective": float(v)} for v in objectives]
+        cube = payload.get("cube")
+        cube_rows = (
+            np.asarray(cube, dtype=np.float32) if cube is not None else None
+        )
+        tenant.algo.observe(params, results, cube=cube_rows)
+        if obs_id is not None:
+            tenant.remember_applied(obs_id)
+        tenant.observes += 1
+        with self._lock:
+            self._stats["observes"] += 1
+        self._dirty = True
+        TELEMETRY.count("serve.observes")
+        return ok_reply(
+            {"applied": True, "n_observed": int(tenant.algo.n_observed)}
+        )
+
+    def _register(self, tenant, payload):
+        reg_id = payload.get("reg_id")
+        if reg_id is not None and reg_id in tenant.applied_ids:
+            return ok_reply({"applied": False})
+        for params in payload.get("params") or []:
+            tenant.algo.register_suggestion(params)
+        if reg_id is not None:
+            tenant.remember_applied(reg_id)
+        self._dirty = True
+        return ok_reply({"applied": True})
+
+    # --- suggest execution ----------------------------------------------------
+    def _run_suggests(self, items):
+        """Resolve, group, coalesce, dispatch, demultiplex."""
+        jobs = []
+        in_cycle = {}  # (tenant, req_id) -> True: originals in THIS cycle
+        deferred = []  # re-asks of an in-cycle original: answer from cache
+        for item in items:
+            payload = item.payload
+            tenant = self._tenants.get(item.tenant_name)
+            if tenant is None:
+                self._finish(
+                    item,
+                    error_reply(
+                        "UnknownTenant",
+                        f"no tenant {item.tenant_name!r} attached",
+                    ),
+                )
+                continue
+            tenant.last_active = time.monotonic()
+            req_id = payload.get("req_id")
+            cached = tenant.reply_cache.get(req_id) if req_id else None
+            if cached is not None:
+                # Idempotent re-ask after a lost reply: hand back the SAME
+                # suggestions — no second RNG draw, no forked stream.
+                tenant.suggests += 1
+                with self._lock:
+                    self._stats["suggests"] += 1
+                self._finish(item, cached)
+                continue
+            if req_id and in_cycle.get((tenant.name, req_id)):
+                # The ORIGINAL of this re-ask is queued in this very cycle
+                # (a timed-out-then-retried request): executing both would
+                # draw twice.  Answer from the reply cache after the
+                # original dispatches.
+                deferred.append((item, tenant, req_id))
+                continue
+            num = int(payload.get("num", 1))
+            if num > tenant.max_q:
+                self._finish(
+                    item,
+                    error_reply(
+                        "GatewayError",
+                        f"suggest num={num} exceeds tenant max_q="
+                        f"{tenant.max_q}",
+                    ),
+                )
+                continue
+            try:
+                exec_algo = self._resolve_exec_algo(tenant, payload)
+                plan_fn = getattr(exec_algo, "fused_step_plan", None)
+                plan = plan_fn(num) if plan_fn is not None else None
+            except Exception as exc:
+                log.exception("suggest prep failed for %r", tenant.name)
+                self._finish(item, error_reply(type(exc).__name__, str(exc)))
+                continue
+            if req_id:
+                in_cycle[(tenant.name, req_id)] = True
+            jobs.append(_SuggestJob(item, tenant, exec_algo, plan, num))
+        fused = [job for job in jobs if job.plan is not None]
+        plain = [job for job in jobs if job.plan is None]
+        groups = OrderedDict()
+        for job in fused:
+            groups.setdefault(job.plan.signature, []).append(job)
+        for group in groups.values():
+            for chunk in _fair_chunks(group, self.max_width):
+                self._dispatch_chunk(chunk)
+        for job in plain:
+            self._dispatch_plain(job)
+        for item, tenant, req_id in deferred:
+            reply = tenant.reply_cache.get(req_id)
+            if reply is None:
+                # The original errored/opted out and cached nothing: back
+                # the re-ask off rather than minting a second draw here.
+                reply = self._retry_after_reply(
+                    f"original of re-asked suggest {req_id!r} cached no reply"
+                )
+            else:
+                tenant.suggests += 1
+                with self._lock:
+                    self._stats["suggests"] += 1
+            self._finish(item, reply)
+
+    def _resolve_exec_algo(self, tenant, payload):
+        """The instance this suggest runs on: the real tenant algorithm, or
+        — for a producer's naive round — a server-side clone rebuilt once
+        per clone epoch with the round's constant-liar lies observed, so N
+        suggests within one producer round share one conditioned copy
+        exactly as they do locally."""
+        if not payload.get("naive"):
+            return tenant.algo
+        epoch = int(payload.get("epoch", 0))
+        if tenant.naive_algo is None or tenant.naive_epoch != epoch:
+            tenant.naive_algo = copy.deepcopy(tenant.algo)
+            tenant.naive_epoch = epoch
+            for lie in payload.get("lies") or []:
+                results = [
+                    {"objective": float(v)} for v in lie.get("objectives", [])
+                ]
+                cube = lie.get("cube")
+                cube_rows = (
+                    np.asarray(cube, dtype=np.float32)
+                    if cube is not None
+                    else None
+                )
+                tenant.naive_algo.observe(
+                    lie.get("params") or [], results, cube=cube_rows
+                )
+        return tenant.naive_algo
+
+    def _dispatch_chunk(self, chunk):
+        """One coalesced (or singleton) fused dispatch + demux."""
+        width = len(chunk)
+        try:
+            if width == 1:
+                job = chunk[0]
+                # Scope retrace detection to the tenant's OWN prewarmer —
+                # exactly what its _suggest_cube would pass locally; the
+                # process-global fallback would let an unrelated tenant's
+                # (or the stacked-axis) warm mask a genuine retrace.
+                rows, state = run_fused_plan(
+                    job.plan,
+                    prewarmer=getattr(job.exec_algo, "_prewarmer", None),
+                )
+                results = [(rows, state)]
+            else:
+                results = run_coalesced_plans([job.plan for job in chunk])
+        except Exception as exc:
+            log.exception("coalesced dispatch of width %d failed", width)
+            for job in chunk:
+                self._finish(
+                    job.item, error_reply(type(exc).__name__, str(exc))
+                )
+            return
+        self._book_dispatch(width)
+        self._maybe_prewarm_width(chunk[0], width)
+        for job, (rows, state) in zip(chunk, results):
+            job.exec_algo.consume_fused_step(state)
+            self._finish_suggest(job, cube=np.asarray(rows))
+
+    def _dispatch_plain(self, job):
+        """Non-fused suggest (random-init phase, host-scheduled algorithms,
+        plugins): the universal ``suggest_batch`` entry, one tenant per
+        dispatch."""
+        try:
+            batch = job.exec_algo.suggest_batch(job.num)
+        except Exception as exc:
+            log.exception("suggest failed for %r", job.tenant.name)
+            self._finish(job.item, error_reply(type(exc).__name__, str(exc)))
+            return
+        if batch is None:
+            self._finish_suggest(job, optout=True)
+            return
+        self._book_dispatch(1)
+        if batch.cube is not None:
+            self._finish_suggest(job, cube=np.asarray(batch.cube)[: job.num])
+        else:
+            self._finish_suggest(job, params=batch.params[: job.num])
+
+    def _book_dispatch(self, width):
+        with self._lock:
+            self._stats["dispatches"] += 1
+            if width > 1:
+                self._stats["coalesced_dispatches"] += 1
+                self._stats["coalesced_suggests"] += width
+            self._stats["max_width"] = max(self._stats["max_width"], width)
+            key = str(width)
+            self._stats["widths"][key] = self._stats["widths"].get(key, 0) + 1
+        TELEMETRY.count("serve.dispatches")
+        TELEMETRY.observe("serve.coalesce.width", width)
+
+    def _maybe_prewarm_width(self, job, width):
+        """PR-4 discipline on the tenant axis: when a dispatch fills its
+        pow-2 width bucket and headroom remains, background-compile the
+        next bucket so a growing coalesce width crosses on a cache hit."""
+        t_pad = _next_pow2(width, floor=1)
+        next_bucket = 2 * t_pad
+        if width == t_pad and next_bucket <= _next_pow2(self.max_width, floor=1):
+            self._prewarmer.maybe_start(
+                ("stacked", next_bucket) + job.plan.signature,
+                prewarm_stacked(job.plan, next_bucket),
+            )
+
+    def _finish_suggest(self, job, cube=None, params=None, optout=False):
+        tenant, payload = job.tenant, job.item.payload
+        if payload.get("naive"):
+            # Mirror Producer._produce: the real stream advances to the
+            # naive copy's — the next clone epoch must not replay keys the
+            # clone already drew.
+            tenant.algo.rng_key = job.exec_algo.rng_key
+        result = {"optout": True} if optout else {}
+        if cube is not None:
+            result["cube"] = np.asarray(cube, dtype=np.float32).tolist()
+        if params is not None:
+            result["params"] = params
+        result["health"] = self._health_fields(job)
+        reply = ok_reply(result)
+        if not optout:
+            # Opt-outs are NOT cached: the producer's re-ask after a
+            # backoff is a genuinely new question against fresher state.
+            tenant.cache_reply(payload.get("req_id"), reply)
+        tenant.suggests += 1
+        with self._lock:
+            self._stats["suggests"] += 1
+        TELEMETRY.count("serve.suggests")
+        if TELEMETRY.enabled:
+            TELEMETRY.observe(
+                tenant.metric_request,
+                time.perf_counter() - job.item.enqueued_at,
+            )
+        self._dirty = True
+        self._finish(job.item, reply)
+
+    def _health_fields(self, job):
+        """Tenant-algorithm health + the serve layer's own fields — the
+        record the client-side adapter surfaces through its producer's
+        health channel (``orion-tpu top``/``info``)."""
+        try:
+            health = dict(job.exec_algo.health_record() or {})
+        except Exception:  # pragma: no cover - observability never breaks serve
+            health = {}
+        health["serve_width"] = job.width
+        health["serve_queue_depth"] = self._queue.qsize()
+        health["serve_tenants"] = len(self._tenants)
+        return health
+
+    # --- stats ----------------------------------------------------------------
+    def stats_snapshot(self):
+        with self._lock:
+            stats = {
+                key: (dict(value) if isinstance(value, dict) else value)
+                for key, value in self._stats.items()
+            }
+            stats["tenants"] = len(self._tenants)
+            stats["queue_depth"] = self._queue.qsize()
+            stats["per_tenant"] = {
+                name: {
+                    "suggests": tenant.suggests,
+                    "observes": tenant.observes,
+                    "inflight": tenant.inflight,
+                    "n_observed": int(tenant.algo.n_observed),
+                }
+                for name, tenant in self._tenants.items()
+            }
+        if stats["suggests"]:
+            stats["dispatches_per_suggest"] = round(
+                stats["dispatches"] / stats["suggests"], 4
+            )
+        else:
+            stats["dispatches_per_suggest"] = None
+        return stats
+
+
+class _SuggestJob:
+    __slots__ = ("item", "tenant", "exec_algo", "plan", "num", "width")
+
+    def __init__(self, item, tenant, exec_algo, plan, num):
+        self.item = item
+        self.tenant = tenant
+        self.exec_algo = exec_algo
+        self.plan = plan
+        self.num = num
+        self.width = 1
+
+
+def _fair_chunks(group, max_width):
+    """Fair-share interleave: round-robin across tenants (arrival order
+    within each tenant) before slicing into ``max_width`` dispatches, so a
+    tenant with a deep backlog cannot push other tenants' single requests
+    out of the first (widest) dispatch."""
+    per_tenant = OrderedDict()
+    for job in group:
+        per_tenant.setdefault(job.tenant.name, deque()).append(job)
+    ordered = []
+    while per_tenant:
+        for name in list(per_tenant):
+            ordered.append(per_tenant[name].popleft())
+            if not per_tenant[name]:
+                del per_tenant[name]
+    chunks = [
+        ordered[i : i + max_width] for i in range(0, len(ordered), max_width)
+    ]
+    for chunk in chunks:
+        for job in chunk:
+            job.width = len(chunk)
+    return chunks
+
+
+def serve(  # pragma: no cover - CLI entry
+    host="127.0.0.1", port=8777, **knobs
+):
+    """Blocking gateway entry point (`orion-tpu serve`)."""
+    server = GatewayServer(host=host, port=port, **knobs)
+    log.info("serving orion-tpu suggest gateway on %s:%s", *server.address)
+    print(
+        f"orion-tpu suggest gateway listening on "
+        f"{server.address[0]}:{server.address[1]} "
+        f"(window={server.window * 1e3:g}ms, max_width={server.max_width})"
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()

@@ -1,0 +1,52 @@
+"""Lint fixture: the serve gateway's wire-send discipline (STO003).
+
+Never imported — linted as source by tests/unit/test_lint_rules.py.
+Self-contained stand-ins shaped like ``orion_tpu/serve/client.py``'s
+request path: a function that puts bytes on the wire (``.sendall``) must
+give every DatabaseError it raises an explicit ``maybe_applied`` decision,
+or the unified retry policy cannot tell a safe resend from a potential
+double-apply.  The bad case below is exactly the patch a careless gateway
+change would ship.
+"""
+
+
+class DatabaseError(Exception):
+    pass
+
+
+def good_gateway_send(sock, rfile, line):
+    """The shipped shape: send-phase loss marked safe, read-phase loss
+    marked ambiguous — both decisions explicit on the raised error."""
+    try:
+        sock.sendall(line)
+    except OSError as exc:
+        error = DatabaseError(f"cannot send to gateway: {exc}")
+        error.maybe_applied = False  # torn request line: nothing applied
+        raise error from exc
+    try:
+        reply = rfile.readline()
+    except OSError as exc:
+        error = DatabaseError(f"gateway connection lost in flight: {exc}")
+        error.maybe_applied = True  # the gateway may have applied it
+        raise error from exc
+    return reply
+
+
+def bad_gateway_send(sock, rfile, line):
+    """A wire-send function raising an undecided DatabaseError: the retry
+    policy would treat the loss as unmarked and blind-resend."""
+    try:
+        sock.sendall(line)
+        return rfile.readline()
+    except OSError as exc:
+        raise DatabaseError(f"gateway request failed: {exc}") from exc  # expect: STO003
+
+
+def bad_gateway_send_variable(sock, line):
+    """Raising a DatabaseError VARIABLE whose maybe_applied was never set
+    fires too (assignment is the decision, not the variable form)."""
+    try:
+        sock.sendall(line)
+    except OSError as exc:
+        error = DatabaseError(f"gateway send failed: {exc}")
+        raise error from exc  # expect: STO003

@@ -117,6 +117,19 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     assert payload["prewarm"]["retraces_after_warm"] in (None, 0)
     if payload["prewarm"]["retraces_after_warm"] == 0:
         assert payload["prewarm"]["prewarms"] >= 1
+    # The serve-gateway leg (orion_tpu.serve): 2 tenants through one
+    # in-process gateway — coalescing actually happened (width >= 2), the
+    # device dispatches were amortized across tenants (< 1 per suggest),
+    # and both tenant experiments audit clean (bench.py hard-asserts all
+    # three before emitting; this pins the payload schema on top).
+    serve = payload["serve"]
+    assert serve["tenants"] == 2
+    assert serve["coalesce_max_width"] >= 2
+    assert serve["dispatches_per_suggest"] < 1.0
+    assert serve["audit_violations"] == 0
+    assert serve["per_tenant"] and all(
+        row["p99_ms"] > 0 for row in serve["per_tenant"].values()
+    )
     for backend in ("sqlite", "network"):
         assert payload["storage_ms"][backend] > 0
         # The batched write path commits a whole q-round as ONE transaction

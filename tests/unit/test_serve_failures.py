@@ -1,0 +1,203 @@
+"""Gateway failure paths, driven through PR 5's byte-level FaultProxy.
+
+The three contracts ISSUE 8 names:
+
+- **gateway restart mid-suggest**: the reply is lost AND the gateway that
+  computed it dies; the client reconnects (landing on the replacement
+  gateway), re-attaches, replays its observation log, re-asks — and the
+  worker registers EXACTLY one set of trials.
+- **observe reply lost**: the applied-but-unknowable resend converges on
+  the client-minted obs_id (no double-observation server-side).
+- **backpressure honored**: a RETRY-AFTER refusal makes the client wait at
+  least the hinted delay before the policy re-asks, and the ask converges.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from orion_tpu.serve.client import GatewayClient, RemoteAlgorithm
+from orion_tpu.serve.gateway import GatewayServer
+from orion_tpu.space.dsl import build_space
+from orion_tpu.storage.faults import FaultProxy
+
+PRIORS = {f"x{i}": "uniform(0, 1)" for i in range(3)}
+ALGO_CFG = {"tpu_bo": {"n_init": 4, "n_candidates": 64, "fit_steps": 4}}
+Q = 4
+
+#: Snappy client policy for fault tests: enough attempts to ride out a
+#: restart, short backoffs so the suite stays fast.
+RETRY = {"max_attempts": 10, "base_delay": 0.05, "max_delay": 0.5,
+         "deadline": 60.0}
+
+
+def _remote_via(proxy_addr, tenant, seed=0):
+    host, port = proxy_addr
+    client = GatewayClient(host=host, port=port, retry=RETRY, idle_probe=0.2)
+    return RemoteAlgorithm(
+        build_space(PRIORS), PRIORS, ALGO_CFG, client, tenant, seed=seed
+    )
+
+
+def _observe_round(algo, rng, n=Q):
+    X = rng.uniform(size=(n, 3)).astype(np.float32)
+    params = [{f"x{i}": float(row[i]) for i in range(3)} for row in X]
+    algo.observe(params, [{"objective": float(v)} for v in rng.uniform(size=n)])
+    return params
+
+
+def test_gateway_restart_mid_suggest_registers_exactly_one_batch(tmp_path):
+    """drop_reply on the suggest + kill/replace the gateway underneath the
+    retry: the re-ask lands on the fresh gateway, UnknownTenant triggers
+    re-attach + replay, and the driving ExperimentClient ends the round
+    with EXACTLY q registered trials."""
+    from orion_tpu.client.experiment import ExperimentClient
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.storage.base import create_storage
+
+    server = GatewayServer(window=0.01)
+    host, port = server.address
+    server.serve_background()
+    proxy = FaultProxy(host, port)
+    proxy_addr = proxy.serve_background()
+    replacement_box = []
+    try:
+        storage = create_storage({"type": "memory"})
+        experiment = build_experiment(
+            storage,
+            "restart-exp",
+            priors=PRIORS,
+            algorithms=ALGO_CFG,
+            pool_size=Q,
+            metadata={"user": "t"},
+        )
+        experiment.serve_config = {
+            "address": f"{proxy_addr[0]}:{proxy_addr[1]}",
+            "retry": RETRY,
+        }
+        experiment.instantiate(seed=2)
+        client = ExperimentClient(experiment)
+
+        # One clean round first, so the restart also has observes to replay.
+        trials = client.suggest(Q)
+        client.observe_all(trials, [0.5] * len(trials))
+
+        # Restart the gateway as soon as the armed drop_reply fires: the
+        # in-flight suggest's reply is eaten AND the gateway that computed
+        # it is gone before the retry lands.
+        restarted = threading.Event()
+
+        def restart_when_fired():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if proxy.faults_fired.get("drop_reply"):
+                    break
+                time.sleep(0.005)
+            server.shutdown()
+            server.server_close()
+            replacement = GatewayServer(host=host, port=port, window=0.01)
+            replacement.serve_background()
+            replacement_box.append(replacement)
+            restarted.set()
+
+        restarter = threading.Thread(target=restart_when_fired, daemon=True)
+        restarter.start()
+        proxy.fail_next("drop_reply")
+        trials = client.suggest(Q)
+        restarter.join(timeout=60)
+        assert restarted.is_set(), "restart thread never saw the fault fire"
+        assert proxy.faults_fired.get("drop_reply") == 1
+        assert len(trials) == Q
+        # EXACTLY one set registered for the round: q reserved by us, and
+        # the storage holds the two rounds' worth of trials, no doubled
+        # batch from the re-ask.
+        all_trials = storage.fetch_trials(uid=experiment.id)
+        assert len(all_trials) == 2 * Q
+    finally:
+        proxy.stop()
+        for replacement in replacement_box:
+            replacement.shutdown()
+            replacement.server_close()
+
+
+def test_observe_reply_lost_resend_converges(tmp_path):
+    server = GatewayServer(window=0.01)
+    host, port = server.address
+    server.serve_background()
+    proxy = FaultProxy(host, port)
+    proxy_addr = proxy.serve_background()
+    try:
+        rng = np.random.default_rng(0)
+        algo = _remote_via(proxy_addr, "obs-exp")
+        _observe_round(algo, rng)  # clean batch
+        proxy.fail_next("drop_reply")
+        _observe_round(algo, rng)  # applied, reply eaten, resent, deduped
+        assert proxy.faults_fired.get("drop_reply") == 1
+        stats = GatewayClient(host=host, port=port).stats()
+        # Converged: the gateway-side algorithm saw each batch ONCE.
+        assert stats["per_tenant"]["obs-exp"]["n_observed"] == 2 * Q
+        assert algo.n_observed == 2 * Q
+    finally:
+        proxy.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def test_backpressure_reply_honored_before_retry(tmp_path):
+    """A full admission queue answers RETRY-AFTER; the client sleeps at
+    least the hint before the policy re-asks, and the op then converges."""
+    server = GatewayServer(window=1.0, max_inflight=1)
+    host, port = server.address
+    server.serve_background()
+    proxy = FaultProxy(host, port)
+    proxy_addr = proxy.serve_background()
+    try:
+        setup = GatewayClient(host=proxy_addr[0], port=proxy_addr[1])
+        setup.request(
+            "attach",
+            {"tenant": "bp-exp", "algo": ALGO_CFG, "priors": PRIORS, "seed": 0},
+        )
+        results = {}
+        errors = []
+
+        def ask(name, delay):
+            try:
+                time.sleep(delay)
+                client = GatewayClient(
+                    host=proxy_addr[0], port=proxy_addr[1], retry=RETRY
+                )
+                t0 = time.monotonic()
+                reply = client.request(
+                    "suggest",
+                    {"tenant": "bp-exp", "num": 2, "req_id": f"{name}:1"},
+                )
+                results[name] = (
+                    reply, client.backpressure_honored, time.monotonic() - t0
+                )
+            except Exception as exc:  # surfaced after join
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=ask, args=("a", 0.0), daemon=True),
+            # Lands while `a` sits in the 1s coalescing window: over the
+            # max_inflight=1 quota -> RETRY-AFTER.
+            threading.Thread(target=ask, args=("b", 0.3), daemon=True),
+        ]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join(timeout=90)
+        assert not errors, errors
+        assert results["a"][0]["cube"] is not None
+        reply_b, honored_b, elapsed_b = results["b"]
+        assert reply_b["cube"] is not None
+        assert honored_b >= 1, "b never saw the backpressure refusal"
+        # Honored: b waited at least the gateway's retry_after hint
+        # (4 * window) on top of its own policy backoff.
+        assert elapsed_b >= 4 * server.window
+        assert server.stats_snapshot()["backpressure"] >= 1
+    finally:
+        proxy.stop()
+        server.shutdown()
+        server.server_close()

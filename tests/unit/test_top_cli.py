@@ -172,3 +172,43 @@ def test_top_iterations_live_mode_exits(tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "orion-tpu top — top-exp" in out
+
+
+def test_top_all_fleet_json_and_frame(tmp_path, capsys):
+    """``top --all``: every experiment in the store in one fleet view (the
+    serve gateway hosts many tenants; no -n required)."""
+    from orion_tpu.cli import main as cli_main
+
+    db_path, storage, _exp = _seed_storage(tmp_path)
+    # A second, health-less experiment must appear too.
+    storage.create_experiment({"name": "quiet-exp", "metadata": {"user": "u"}})
+    rc = cli_main(["top", "--all", "--storage-path", db_path, "--json"])
+    assert rc == 0
+    snap = json.loads(capsys.readouterr().out)
+    names = [e["experiment"] for e in snap["experiments"]]
+    assert names == ["quiet-exp", "top-exp"]
+    top_exp = snap["experiments"][names.index("top-exp")]
+    assert set(top_exp["workers"]) == {"host-a:1", "host-b:2"}
+    # The live fleet frame renders one row per experiment.
+    rc = cli_main(
+        ["top", "--all", "--storage-path", db_path, "--iterations", "1",
+         "-i", "0.1"]
+    )
+    assert rc == 0
+    frame = capsys.readouterr().out
+    assert "top --all" in frame
+    assert "top-exp v1" in frame and "quiet-exp v1" in frame
+
+
+def test_info_all_prints_every_experiment(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+
+    db_path, storage, _exp = _seed_storage(tmp_path)
+    storage.create_experiment({"name": "quiet-exp", "metadata": {"user": "u"}})
+    rc = cli_main(["info", "--all", "--storage-path", db_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "name: top-exp" in out and "name: quiet-exp" in out
+    # Health section (with the per-worker records) rides along for the
+    # experiment that recorded health.
+    assert "health records: 6 from 2 worker(s)" in out
