@@ -298,7 +298,7 @@ def bench_storage(q=Q, rounds=3):
     try:
         _run("network", net_db, lambda: net_db.wire_requests)
     finally:
-        net_db._close()
+        net_db.close()
         server.shutdown()
         server.server_close()
     return storage_ms, storage_ops
@@ -931,7 +931,7 @@ def main_chaos(rounds=6, q=8, seed=11):
                 }
                 assert client.reconnects >= 1
             finally:
-                client._close()
+                client.close()
                 proxy.stop()
                 server.shutdown()
                 server.server_close()
@@ -1005,10 +1005,32 @@ def main_smoke(trace_out="bench_trace.json"):
     assert gate["pass"], f"committed regret baseline fails its own gate: {gate}"
     # Tiny serve leg (orion_tpu.serve): 2 tenants, full producer stack over
     # one in-process gateway — coalesce width >= 2, device dispatches per
-    # suggest < 1, and clean audits are hard-asserted inside.
-    serve_block = bench_serve(
-        m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=128, fit_steps=4
-    )
+    # suggest < 1, and clean audits are hard-asserted inside.  The leg runs
+    # UNDER the runtime concurrency sanitizer (orion-tpu tsan): instrumented
+    # lock/event shims + vector-clock race detection over the gateway's
+    # annotated shared cells, with a seeded interleaving explorer perturbing
+    # the dispatcher's schedules — the payload's `tsan_violations: 0` is a
+    # hard assert, the dynamic twin of lint_preflight's static gate.
+    from orion_tpu.analysis.sanitizer import TSAN
+
+    # The whole bench may itself be running under `orion-tpu tsan` (env
+    # instrumentation from process start): then the outer owner keeps the
+    # patches and we assert on a snapshot instead of fighting over enable.
+    tsan_owned = not TSAN.enabled
+    if tsan_owned:
+        TSAN.enable(seed=0)
+    try:
+        serve_block = bench_serve(
+            m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=128, fit_steps=4
+        )
+    finally:
+        tsan_report = TSAN.disable() if tsan_owned else TSAN.snapshot_report()
+    if tsan_report.violation_count():
+        # Not an assert: the gate must hold under `python -O` too.
+        raise SystemExit(
+            "serve leg failed the concurrency sanitizer:\n"
+            + tsan_report.format_human()
+        )
     trace_file = _safe_trace(trace_out)
     payload = _json_payload(
         metric=(
@@ -1031,6 +1053,7 @@ def main_smoke(trace_out="bench_trace.json"):
     )
     payload["trace_file"] = trace_file
     payload["lint_violations"] = lint_violations
+    payload["tsan_violations"] = tsan_report.violation_count()
     payload["serve"] = serve_block
     print(json.dumps(payload))
 

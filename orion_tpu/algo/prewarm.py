@@ -32,6 +32,7 @@ import time
 # key on pow-2 buckets, and a per-call function-level import was pure
 # overhead once a second subsystem started planning buckets.
 from orion_tpu.algo.history import _next_pow2
+from orion_tpu.analysis.sanitizer import TSAN
 from orion_tpu.health import FLIGHT
 from orion_tpu.telemetry import TELEMETRY
 
@@ -59,12 +60,14 @@ def completed_prewarm_count():
     """Monotonic count of finished prewarm compile attempts (success or
     failure — either may have inserted a jit-cache entry)."""
     with _completed_lock:
+        TSAN.read("prewarm._completed_count")
         return _completed_count
 
 
 def _note_prewarm_completed():
     global _completed_count
     with _completed_lock:
+        TSAN.write("prewarm._completed_count")
         _completed_count += 1
 
 
@@ -141,6 +144,7 @@ class BucketPrewarmer:
         """Run ``compile_fn`` on a background thread unless ``key`` was
         already started.  Returns True when a new prewarm was launched."""
         with self._lock:
+            TSAN.write("BucketPrewarmer._threads", self)
             if key in self._started:
                 return False
             self._started.add(key)
@@ -164,6 +168,7 @@ class BucketPrewarmer:
         finally:
             _note_prewarm_completed()
             with self._lock:
+                TSAN.write("BucketPrewarmer._threads", self)
                 self._completed += 1
         TELEMETRY.count("jax.prewarms")
         TELEMETRY.record_span("jax.prewarm.compile", start=t0)
@@ -180,15 +185,25 @@ class BucketPrewarmer:
         compiles share the caller's jit signatures instead of being
         blinded by unrelated instances' warms."""
         with self._lock:
+            TSAN.read("BucketPrewarmer._threads", self)
             return self._completed
 
     def wait(self, timeout=None):
         """Join every launched prewarm thread (tests / deterministic
-        boundary crossings).  ``timeout`` is per-thread."""
-        for thread in list(self._threads.values()):
+        boundary crossings).  ``timeout`` is per-thread.  The thread map is
+        snapshotted under the lock — iterating it bare races maybe_start
+        from another thread (found by the runtime sanitizer; joining
+        happens outside the lock so a slow compile never blocks new
+        prewarm launches)."""
+        for thread in self._thread_snapshot():
             thread.join(timeout)
 
     @property
     def in_flight(self):
         """True while any prewarm compile is still running."""
-        return any(t.is_alive() for t in self._threads.values())
+        return any(t.is_alive() for t in self._thread_snapshot())
+
+    def _thread_snapshot(self):
+        with self._lock:
+            TSAN.read("BucketPrewarmer._threads", self)
+            return list(self._threads.values())

@@ -16,17 +16,17 @@ runtime cannot check for itself:
 ``run_lint(paths)`` is the whole API; the tier-1 self-lint test and the
 bench ``--smoke`` preflight both call it directly.  Rule catalog and
 suppression syntax: ``docs/static_analysis.md``.
-"""
 
-from orion_tpu.analysis.engine import (
-    Diagnostic,
-    Rule,
-    default_rules,
-    format_human,
-    format_json,
-    rule_catalog,
-    run_lint,
-)
+The dynamic half lives in ``orion_tpu.analysis.sanitizer`` (``orion-tpu
+tsan``): instrumented lock shims, vector-clock race detection, and the
+static↔dynamic cross-check that feeds runtime-observed lock edges back
+into the ``LCK`` graph as ``LCK003`` findings.
+
+The package facade is LAZY (PEP 562): ``sanitizer`` is stdlib-only and
+imported at module scope by the telemetry/health/serve/storage hot paths
+for their cell annotations — an eager engine import here would tax every
+process start ~35 ms for a lint facility most processes never run.
+"""
 
 __all__ = [
     "Diagnostic",
@@ -37,3 +37,11 @@ __all__ = [
     "rule_catalog",
     "run_lint",
 ]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from orion_tpu.analysis import engine
+
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

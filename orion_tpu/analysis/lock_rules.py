@@ -1,22 +1,34 @@
-"""Lock-order & shared-state safety rules (``LCK001``–``LCK002``).
+"""Lock-order & shared-state safety rules (``LCK001``–``LCK003``).
 
 The process hosts a growing set of cross-thread objects — the telemetry
-ring, the bucket prewarmer, the network driver's socket, the COW
-histories — each with its own lock.  Deadlock needs only two of them
-acquired in opposite orders on two threads, and the hang reproduces only
-under production concurrency.  So the checker builds the static lock
+ring, the bucket prewarmer, the network driver's socket, the serve
+gateway's tenant tables — each with its own lock.  Deadlock needs only two
+of them acquired in opposite orders on two threads, and the hang reproduces
+only under production concurrency.  So the checker builds the static lock
 graph: every declared lock (``self._x = threading.Lock()`` in a class,
-``_x = threading.Lock()`` at module level), every ``with <lock>:``
-nesting (one edge per outer→inner pair), plus one level of call
-resolution (a call made while holding lock A to a method that directly
-acquires lock B adds A→B — this is how the cross-module edges like
-``NetworkDB._lock → Telemetry._lock`` appear).  A cycle in that graph is
-``LCK001``.
+``_x = threading.Lock()`` at module level), every ``with <lock>:`` nesting
+(one edge per outer→inner pair), plus **two levels** of call resolution —
+a call made while holding lock A resolves through the callee AND the
+callee's own direct callees, so ``with self._lock: self._flush()`` where
+``_flush`` calls ``TELEMETRY.count`` still finds the
+``NetworkDB._lock → Telemetry._lock`` edge.  A **context-managed callee**
+(``with self._guard():`` — the serve gateway's dominant idiom) contributes
+the locks it acquires as held for the with-body, exactly like the plain
+call form.  A cycle in the graph is ``LCK001``.
 
 ``LCK002`` is the simpler data-race screen: within a class that owns a
 lock, an attribute assigned both inside and outside ``with <lock>:``
 scopes is flagged at its unlocked sites (lifecycle methods are exempt —
 ``__init__``/``__setstate__`` run before the object is shared).
+
+``LCK003`` closes the static↔dynamic loop with the runtime sanitizer
+(``orion_tpu.analysis.sanitizer``, ``orion-tpu tsan``): a lock-order edge
+*observed at runtime* between two statically-known locks that the static
+graph never derived is a resolver blind spot — usually a lock-owning
+object reached through a parameter or callback the AST cannot follow.  The
+rule is silent unless runtime edges are supplied (in-process via
+``sanitizer.set_lint_runtime_edges`` or the ``ORION_TPU_TSAN_EDGES`` env
+file), so plain lint runs are unaffected.
 """
 
 import ast
@@ -59,13 +71,20 @@ def _is_lock_factory(value):
 
 class _FunctionScan:
     """With-nesting walk of one function body: direct acquisitions, nested
-    lock edges, and calls made while holding locks."""
+    lock edges, calls made while holding locks, and the full callee set
+    (for the second resolution level).
+
+    Edge/held entries are *tokens*: either a lock id string, or
+    ``("call", name)`` for a context-managed callee — the with-item
+    ``with self._guard():`` holds whatever ``_guard`` acquires, which only
+    the project index can expand (``build_static_edges`` does)."""
 
     def __init__(self, resolve):
         self._resolve = resolve  # expr -> lock id or None
         self.acquired = set()  # lock ids directly acquired
-        self.edges = []  # (outer, inner, lineno)
-        self.calls_under_lock = []  # (held frozenset, callee key, lineno)
+        self.call_names = set()  # every dotted callee name in the body
+        self.edges = []  # (outer token, inner token, lineno)
+        self.calls_under_lock = []  # (held token frozenset, callee name, lineno)
         self.assignment_sites = []  # (attr, under_lock, node)
 
     def walk(self, fn, class_locks):
@@ -86,12 +105,22 @@ class _FunctionScan:
                     for outer in held + pushed:
                         self.edges.append((outer, lock, node.lineno))
                     pushed.append(lock)
-                elif held + pushed:
-                    # A non-lock with-item entered while locks are held is
-                    # still a call made under them ('with lock: with
-                    # obj.enter():' acquires whatever the callee acquires,
-                    # same as the plain-call form).
-                    self._scan_calls(item.context_expr, held + pushed)
+                    continue
+                # A non-lock with-item is a call made under the current
+                # holds ('with lock: with obj.enter():' acquires whatever
+                # the callee acquires) — scanned BEFORE its own token is
+                # pushed, so the callee is not recorded under itself.
+                self._scan_calls(item.context_expr, held + pushed)
+                if isinstance(item.context_expr, ast.Call):
+                    name = dotted_name(item.context_expr.func)
+                    if name:
+                        # Context-managed callee: its acquired locks are
+                        # HELD for the body (the gateway idiom LCK001 must
+                        # see through) — expanded at finalize time.
+                        token = ("call", name)
+                        for outer in held + pushed:
+                            self.edges.append((outer, token, node.lineno))
+                        pushed.append(token)
             self._visit_block(node.body, held + pushed)
             return
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -99,12 +128,14 @@ class _FunctionScan:
             self._visit_block(node.body, [])
             return
         self._note_assignments(node, held)
-        if held:
-            # Record calls in this statement's expression children (nested
-            # with-bodies are re-visited below with the fuller held set —
-            # recording them here too is redundant but still sound: the
-            # outer lock IS held there).
-            for sub in ast.iter_child_nodes(node):
+        # Record calls in this statement's expression children — under the
+        # current holds for edge formation, and unconditionally into
+        # call_names for the second resolution level.  (Nested with-bodies
+        # are re-visited below with the fuller held set — recording them
+        # here too is redundant but still sound: the outer lock IS held
+        # there.)
+        for sub in ast.iter_child_nodes(node):
+            if not isinstance(sub, (ast.stmt,) + _STMT_LIST_CHILDREN):
                 self._scan_calls(sub, held)
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.stmt):
@@ -124,9 +155,11 @@ class _FunctionScan:
         if isinstance(node, ast.Call):
             name = dotted_name(node.func)
             if name:
-                self.calls_under_lock.append(
-                    (frozenset(held), name, node.lineno)
-                )
+                self.call_names.add(name)
+                if held:
+                    self.calls_under_lock.append(
+                        (frozenset(held), name, node.lineno)
+                    )
         for child in ast.iter_child_nodes(node):
             self._scan_calls(child, held)
 
@@ -136,7 +169,9 @@ class _FunctionScan:
             targets = node.targets
         elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
             targets = [node.target]
-        under_class_lock = any(lock in self._class_locks for lock in held)
+        under_class_lock = any(
+            isinstance(lock, str) and lock in self._class_locks for lock in held
+        )
         for target in targets:
             base = target
             if isinstance(base, ast.Subscript):
@@ -152,13 +187,15 @@ class _FunctionScan:
 
 
 class _ProjectIndex:
-    """Cross-file lock inventory shared by both rules."""
+    """Cross-file lock inventory shared by the LCK rules and the runtime
+    sanitizer's cross-check."""
 
     def __init__(self, modules):
         self.class_locks = {}  # class name -> set of lock ids "Class.attr"
         self.module_locks = {}  # module name -> {var name -> lock id}
         self.instance_of = {}  # module-level instance var -> class name
         self.fn_acquired = {}  # callee key -> set of lock ids
+        self.fn_callees = {}  # callee key -> set of callee keys it calls
         self.fn_scans = []  # (module, class name or None, fn node, scan)
         self._collect_declarations(modules)
         self._scan_functions(modules)
@@ -237,6 +274,11 @@ class _ProjectIndex:
         else:
             key = ("fn", mod, fn.name)
         self.fn_acquired.setdefault(key, set()).update(scan.acquired)
+        callees = self.fn_callees.setdefault(key, set())
+        for name in scan.call_names:
+            callee = self.callee_key(module, class_name, name)
+            if callee is not None and callee != key:
+                callees.add(callee)
 
     def callee_key(self, module, class_name, call_name):
         """Map a dotted call like 'self._close' / '_note_done' /
@@ -252,9 +294,20 @@ class _ProjectIndex:
             return ("method", owner, parts[-1])
         return None
 
+    def acquired_through(self, key, depth=2):
+        """Locks acquired by ``key`` resolved through ``depth`` call
+        levels: its own direct acquisitions plus (at depth 2) those of its
+        direct callees — 'a call under lock A to a method whose helper
+        takes lock B' now contributes A→B."""
+        acquired = set(self.fn_acquired.get(key, ()))
+        if depth > 1:
+            for callee in self.fn_callees.get(key, ()):
+                acquired |= self.fn_acquired.get(callee, set())
+        return acquired
+
 
 def _project_index(modules):
-    """Build the whole-project scan once per run: both LCK rules receive
+    """Build the whole-project scan once per run: the LCK rules receive
     the same modules list from one run_lint call, so the index is cached on
     the first Module and dies with the run — a process-global cache would
     pin every parsed AST for the life of the process (bench.py's lint
@@ -269,80 +322,125 @@ def _project_index(modules):
     return cached[1]
 
 
+def project_index(modules):
+    """Public entry for the runtime sanitizer's cross-check
+    (``sanitizer.cross_check_static``)."""
+    return _project_index(modules)
+
+
+def _expand_token(index, module, class_name, token):
+    """A scan token -> the set of lock ids it stands for: a lock id is
+    itself; a ``("call", name)`` context-managed callee expands to the
+    locks the callee acquires through two resolution levels."""
+    if isinstance(token, str):
+        return {token}
+    key = index.callee_key(module, class_name, token[1])
+    if key is None:
+        return set()
+    return index.acquired_through(key)
+
+
+def build_static_edges(index):
+    """THE static lock-order graph: ``{outer: {inner: (path, line)}}``,
+    from with-nesting, two-level call resolution, and context-managed
+    callees.  Shared by LCK001, LCK003 and the sanitizer cross-check so
+    "the static graph" means one thing everywhere."""
+    edges = {}
+
+    def add(outer, inner, module, line):
+        if inner != outer:
+            edges.setdefault(outer, {}).setdefault(inner, (module.path, line))
+
+    for module, class_name, _fn, scan in index.fn_scans:
+        for outer_token, inner_token, line in scan.edges:
+            for outer in _expand_token(index, module, class_name, outer_token):
+                for inner in _expand_token(index, module, class_name, inner_token):
+                    add(outer, inner, module, line)
+        for held, call_name, line in scan.calls_under_lock:
+            key = index.callee_key(module, class_name, call_name)
+            if key is None:
+                continue
+            inners = index.acquired_through(key)
+            if not inners:
+                continue
+            for token in held:
+                for outer in _expand_token(index, module, class_name, token):
+                    for inner in inners:
+                        add(outer, inner, module, line)
+    return edges
+
+
+def known_lock_ids(index):
+    """Every declared lock id the index knows (class + module locks)."""
+    known = set()
+    for locks in index.class_locks.values():
+        known |= locks
+    for locks in index.module_locks.values():
+        known |= set(locks.values())
+    return known
+
+
+def iter_edge_cycles(edges):
+    """Cycles in a ``{outer: {inner: meta}}`` graph, yielded once each as
+    ``(cycle_tuple, closing_node, closing_child)`` — the closing edge is
+    where LCK001 anchors its diagnostic.  Iterative DFS with a recursion
+    stack."""
+    seen_cycles = set()
+    visited = set()
+    for start in sorted(edges):
+        stack = [(start, iter(sorted(edges.get(start, {}))))]
+        on_path = [start]
+        while stack:
+            node, children = stack[-1]
+            advanced = False
+            for child in children:
+                if child in on_path:
+                    cycle = tuple(on_path[on_path.index(child):] + [child])
+                    key = frozenset(cycle)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        yield cycle, node, child
+                    continue
+                if (node, child) not in visited:
+                    visited.add((node, child))
+                    stack.append(
+                        (child, iter(sorted(edges.get(child, {}))))
+                    )
+                    on_path.append(child)
+                    advanced = True
+                    break
+            if not advanced:
+                stack.pop()
+                on_path.pop()
+
+
 class LockOrderCycle(Rule):
     id = "LCK001"
     name = "lock-order-cycle"
     description = (
-        "The static lock graph (with-nesting plus one level of calls made "
-        "while holding a lock) must stay acyclic: a cycle means two "
-        "threads can acquire the same locks in opposite orders and "
-        "deadlock under production concurrency."
+        "The static lock graph (with-nesting plus two levels of call "
+        "resolution, including context-managed callees) must stay acyclic: "
+        "a cycle means two threads can acquire the same locks in opposite "
+        "orders and deadlock under production concurrency."
     )
 
     def begin(self, modules):
         self._index = _project_index(modules)
 
     def finalize(self):
-        index = self._index
-        edges = {}  # outer -> {inner: (path, line)}
-        for module, class_name, _fn, scan in index.fn_scans:
-            for outer, inner, line in scan.edges:
-                if inner != outer:
-                    edges.setdefault(outer, {}).setdefault(
-                        inner, (module.path, line)
-                    )
-            for held, call_name, line in scan.calls_under_lock:
-                key = index.callee_key(module, class_name, call_name)
-                if key is None:
-                    continue
-                for inner in index.fn_acquired.get(key, ()):
-                    for outer in held:
-                        if inner != outer:
-                            edges.setdefault(outer, {}).setdefault(
-                                inner, (module.path, line)
-                            )
-        yield from self._find_cycles(edges)
-
-    def _find_cycles(self, edges):
-        # Iterative DFS with a recursion stack; each cycle reported once at
-        # the edge that closes it.
-        seen_cycles = set()
-        visited = set()
-        for start in sorted(edges):
-            stack = [(start, iter(sorted(edges.get(start, {}))))]
-            on_path = [start]
-            while stack:
-                node, children = stack[-1]
-                advanced = False
-                for child in children:
-                    if child in on_path:
-                        cycle = tuple(on_path[on_path.index(child) :] + [child])
-                        key = frozenset(cycle)
-                        if key not in seen_cycles:
-                            seen_cycles.add(key)
-                            path, line = edges[node][child]
-                            yield Diagnostic(
-                                path,
-                                line,
-                                0,
-                                self.id,
-                                "lock-order cycle: "
-                                + " -> ".join(cycle)
-                                + " (another thread may acquire these in "
-                                "the opposite order and deadlock)",
-                            )
-                        continue
-                    if (node, child) not in visited:
-                        visited.add((node, child))
-                        stack.append(
-                            (child, iter(sorted(edges.get(child, {}))))
-                        )
-                        on_path.append(child)
-                        advanced = True
-                        break
-                if not advanced:
-                    stack.pop()
-                    on_path.pop()
+        edges = build_static_edges(self._index)
+        for cycle, node, child in iter_edge_cycles(edges):
+            path, line = edges[node][child]
+            yield Diagnostic(
+                path,
+                line,
+                0,
+                self.id,
+                "lock-order cycle: "
+                + " -> ".join(cycle)
+                + " (another thread may acquire these in "
+                "the opposite order and deadlock)",
+            )
 
 
 class UnlockedSharedMutation(Rule):
@@ -390,4 +488,61 @@ class UnlockedSharedMutation(Rule):
                 )
 
 
-LOCK_RULES = (LockOrderCycle, UnlockedSharedMutation)
+class UnmodeledRuntimeEdge(Rule):
+    id = "LCK003"
+    name = "runtime-edge-missing-from-static-graph"
+    description = (
+        "A lock-order edge the runtime sanitizer observed between two "
+        "statically-declared locks must exist in the static lock graph — "
+        "an unmodeled edge is a resolver blind spot (a lock-owning object "
+        "reached through a parameter or callback) that silently exempts "
+        "that acquisition path from LCK001 cycle checking.  Silent unless "
+        "runtime edges are supplied (orion-tpu tsan's cross-check, "
+        "sanitizer.set_lint_runtime_edges, or ORION_TPU_TSAN_EDGES)."
+    )
+
+    def begin(self, modules):
+        self._index = _project_index(modules)
+        # Runtime reports carry absolute paths; linted modules whatever the
+        # caller passed.  Re-anchoring a finding to the LINTED path is what
+        # lets a suppression comment at the acquisition site argue it away.
+        self._by_realpath = {os.path.realpath(m.path): m.path for m in modules}
+        from orion_tpu.analysis.sanitizer import lint_runtime_edges
+
+        self._runtime = lint_runtime_edges()
+
+    def finalize(self):
+        if not self._runtime:
+            return
+        edges = build_static_edges(self._index)
+        static_pairs = {
+            (outer, inner) for outer in edges for inner in edges[outer]
+        }
+        known = known_lock_ids(self._index)
+        for edge in self._runtime:
+            outer = edge.get("outer")
+            inner = edge.get("inner")
+            if not outer or not inner or (outer, inner) in static_pairs:
+                continue
+            # Both endpoints must be locks the linted tree DECLARES —
+            # otherwise the report came from code outside this lint run
+            # (e.g. a fixture dir checked against a full-app report) and
+            # there is no graph to extend here.
+            if outer not in known or inner not in known:
+                continue
+            path = str(edge.get("path", "<runtime>"))
+            path = self._by_realpath.get(os.path.realpath(path), path)
+            yield Diagnostic(
+                path,
+                int(edge.get("line", 1) or 1),
+                0,
+                self.id,
+                f"runtime-observed lock edge {outer} -> {inner} is missing "
+                "from the static lock graph: the static resolver cannot "
+                "see this acquisition path, so LCK001 cannot check it for "
+                "cycles — restructure the acquisition so the resolver sees "
+                "it, or suppress here with the reason the ordering is safe",
+            )
+
+
+LOCK_RULES = (LockOrderCycle, UnlockedSharedMutation, UnmodeledRuntimeEdge)

@@ -44,6 +44,10 @@ import threading
 import time
 import traceback
 
+# Annotated-cell hooks for the runtime concurrency sanitizer
+# (orion-tpu tsan) — same disabled-path cost discipline as recording.
+from orion_tpu.analysis.sanitizer import TSAN
+
 _ENABLE_VALUES = ("1", "on", "true", "yes")
 
 DEFAULT_FLIGHT_CAPACITY = 512
@@ -154,6 +158,7 @@ class FlightRecorder:
             if args:
                 event["args"] = dict(args)
             with self._lock:
+                TSAN.write("FlightRecorder._ring", self)
                 self._ring[self._seq % self._capacity] = event
                 self._seq += 1
         except Exception:  # pragma: no cover - must never raise into hot path
@@ -162,6 +167,7 @@ class FlightRecorder:
     def events(self):
         """Every event currently in the ring, oldest first."""
         with self._lock:
+            TSAN.read("FlightRecorder._ring", self)
             start = max(0, self._seq - self._capacity)
             return [self._ring[i % self._capacity] for i in range(start, self._seq)]
 
@@ -170,6 +176,7 @@ class FlightRecorder:
         (the producer's storage-mirror channel; wraparound between drains
         drops the overwritten oldest, by design)."""
         with self._lock:
+            TSAN.write("FlightRecorder._ring", self)  # advances the drain cursor
             start = max(self._drained, self._seq - self._capacity)
             out = [self._ring[i % self._capacity] for i in range(start, self._seq)]
             self._drained = self._seq
@@ -177,6 +184,7 @@ class FlightRecorder:
 
     def clear(self):
         with self._lock:
+            TSAN.write("FlightRecorder._ring", self)
             self._ring = [None] * self._capacity
             self._seq = 0
             self._drained = 0

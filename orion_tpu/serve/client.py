@@ -43,6 +43,7 @@ from collections import deque
 import numpy as np
 
 from orion_tpu.algo.base import BaseAlgorithm
+from orion_tpu.analysis.sanitizer import TSAN
 from orion_tpu.serve.protocol import (
     GatewayError,
     RetryAfterError,
@@ -99,6 +100,7 @@ class GatewayClient:
 
     # --- wire ----------------------------------------------------------------
     def _connect(self):
+        TSAN.write("GatewayClient._conn", self)
         self._close()
         sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout
@@ -112,6 +114,7 @@ class GatewayClient:
         self._last_used = time.monotonic()
 
     def _close(self):
+        TSAN.write("GatewayClient._conn", self)
         for closer in (self._file, self._sock):
             if closer is not None:
                 try:
@@ -149,6 +152,7 @@ class GatewayClient:
         carries ``maybe_applied`` for the retry policy."""
         for attempt in range(2):
             try:
+                TSAN.write("GatewayClient._conn", self)
                 self._probe_idle_connection()
                 if self._sock is None:
                     self._connect()
@@ -191,7 +195,12 @@ class GatewayClient:
         message = response.get("message", "")
         if error == "RetryAfter":
             delay = float(response.get("retry_after", 0.05))
-            self.backpressure_honored += 1
+            # Under the client lock: _translate runs after request()
+            # released it, and the counter is shared client state (the
+            # bare increment was a sanitizer-found lost-update race).
+            with self._lock:
+                TSAN.write("GatewayClient._conn", self)
+                self.backpressure_honored += 1
             TELEMETRY.count("serve.client.backpressure")
             # Honor the gateway's pacing hint BEFORE surfacing the
             # transient refusal — the retry policy then adds its own

@@ -48,6 +48,7 @@ from collections import OrderedDict, deque
 import numpy as np
 
 from orion_tpu.algo.base import BaseAlgorithm, create_algo
+from orion_tpu.analysis.sanitizer import TSAN
 from orion_tpu.algo.history import _next_pow2
 from orion_tpu.algo.prewarm import BucketPrewarmer
 from orion_tpu.algo.tpu_bo import run_fused_plan
@@ -108,6 +109,7 @@ class _Tenant:
         )
 
     def remember_applied(self, applied_id):
+        TSAN.write("GatewayServer.tenant_ledgers", self)
         self.applied_ids.add(applied_id)
         self.applied_order.append(applied_id)
         while len(self.applied_order) > APPLIED_IDS_CAP:
@@ -116,6 +118,7 @@ class _Tenant:
     def cache_reply(self, req_id, reply):
         if not req_id:
             return
+        TSAN.write("GatewayServer.tenant_ledgers", self)
         self.reply_cache[req_id] = reply
         while len(self.reply_cache) > REPLY_CACHE_CAP:
             self.reply_cache.popitem(last=False)
@@ -126,6 +129,7 @@ class _Tenant:
         The applied-id ledger rides along — a client replaying its log
         against a restored-but-stale tenant must have the already-
         snapshotted batches dedup, not double-observe."""
+        TSAN.read("GatewayServer.tenant_ledgers", self)
         return {
             "priors": dict(self.priors),
             "algo_config": self.algo_config,
@@ -293,6 +297,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                 # _restore runs from __init__ (pre-thread), but tenant-map
                 # writes stay under the lock everywhere for one invariant.
                 with self._lock:
+                    TSAN.write("GatewayServer._tenants", self)
                     self._tenants[name] = tenant
             except Exception:
                 log.exception("could not restore tenant %r", name)
@@ -303,15 +308,21 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             )
 
     def _write_snapshot(self):
-        """Dispatcher-thread-only: the algorithms are single-threaded state,
-        so the snapshot dict is built here and written atomically (the rate
-        limit keeps the O(history) ``state_dict`` walk off every round)."""
-        snapshot = {
-            "tenants": {
-                name: tenant.state_snapshot()
-                for name, tenant in self._tenants.items()
+        """Build + write the tenant snapshot atomically.  The build holds
+        the gateway lock: the dispatcher owns it in steady state, but
+        ``shutdown()`` (and tests) call it from OTHER threads, and the
+        sanitizer flagged the bare tenant-table/ledger reads racing the
+        dispatcher's mutations.  The rate limit keeps the O(history)
+        ``state_dict`` walk off every round; the file write stays outside
+        the lock."""
+        with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
+            snapshot = {
+                "tenants": {
+                    name: tenant.state_snapshot()
+                    for name, tenant in self._tenants.items()
+                }
             }
-        }
         atomic_pickle_dump(self.persist, snapshot)
         self._dirty = False
         self._last_persist = time.monotonic()
@@ -369,6 +380,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         per-tenant inflight quota.  Returns a refusal reply, or None when
         the item was queued."""
         with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
             if self._queue.qsize() >= self.pending_limit:
                 self._stats["backpressure"] += 1
                 refused = True
@@ -380,6 +392,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                 if item.op == "suggest":
                     tenant = self._tenants.get(item.tenant_name)
                     if tenant is not None:
+                        TSAN.write("GatewayServer.tenant_counters", self)
                         if tenant.inflight >= tenant.max_inflight:
                             self._stats["backpressure"] += 1
                             refused = True
@@ -450,6 +463,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
     def _finish(self, item, reply):
         if item.counted:
             with self._lock:
+                TSAN.write("GatewayServer.tenant_counters", self)
                 tenant = self._tenants.get(item.tenant_name)
                 if tenant is not None:
                     tenant.inflight = max(0, tenant.inflight - 1)
@@ -484,9 +498,11 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             return self._attach(payload)
         if item.op == "detach":
             with self._lock:
+                TSAN.write("GatewayServer._tenants", self)
                 self._tenants.pop(item.tenant_name, None)
             self._dirty = True
             return ok_reply({"detached": True})
+        TSAN.read("GatewayServer._tenants", self)
         tenant = self._tenants.get(item.tenant_name)
         if tenant is None:
             return error_reply(
@@ -503,6 +519,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         name = str(payload.get("tenant") or "")
         if not name:
             return error_reply("GatewayError", "attach requires a tenant name")
+        TSAN.read("GatewayServer._tenants", self)
         tenant = self._tenants.get(name)
         if tenant is not None:
             tenant.last_active = time.monotonic()
@@ -539,6 +556,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             min(self.max_q, int(quotas.get("max_q") or self.max_q)),
         )
         with self._lock:
+            TSAN.write("GatewayServer._tenants", self)
             self._tenants[name] = tenant
         self._dirty = True
         TELEMETRY.count("serve.attaches")
@@ -557,6 +575,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         client-side replay log — eviction costs a re-attach + replay, not
         data."""
         with self._lock:
+            TSAN.write("GatewayServer._tenants", self)
             idle = [t for t in self._tenants.values() if t.inflight == 0]
             if not idle:
                 return None
@@ -572,6 +591,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
 
     def _observe(self, tenant, payload):
         obs_id = payload.get("obs_id")
+        TSAN.read("GatewayServer.tenant_ledgers", tenant)
         if obs_id is not None and obs_id in tenant.applied_ids:
             # Applied-and-reply-lost resend: ack without re-feeding the
             # algorithm — THE convergence contract mode="always" rides on.
@@ -591,10 +611,15 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             np.asarray(cube, dtype=np.float32) if cube is not None else None
         )
         tenant.algo.observe(params, results, cube=cube_rows)
-        if obs_id is not None:
-            tenant.remember_applied(obs_id)
-        tenant.observes += 1
+        # Under the gateway lock: stats_snapshot reads the counters from
+        # handler threads and _write_snapshot reads the applied ledger from
+        # the shutdown thread — the bare mutations were sanitizer-found
+        # data races.
         with self._lock:
+            if obs_id is not None:
+                tenant.remember_applied(obs_id)
+            TSAN.write("GatewayServer.tenant_counters", self)
+            tenant.observes += 1
             self._stats["observes"] += 1
         self._dirty = True
         TELEMETRY.count("serve.observes")
@@ -604,12 +629,15 @@ class GatewayServer(socketserver.ThreadingTCPServer):
 
     def _register(self, tenant, payload):
         reg_id = payload.get("reg_id")
+        TSAN.read("GatewayServer.tenant_ledgers", tenant)
         if reg_id is not None and reg_id in tenant.applied_ids:
             return ok_reply({"applied": False})
         for params in payload.get("params") or []:
             tenant.algo.register_suggestion(params)
         if reg_id is not None:
-            tenant.remember_applied(reg_id)
+            # Ledger writes ride the gateway lock (see _observe).
+            with self._lock:
+                tenant.remember_applied(reg_id)
         self._dirty = True
         return ok_reply({"applied": True})
 
@@ -621,6 +649,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         deferred = []  # re-asks of an in-cycle original: answer from cache
         for item in items:
             payload = item.payload
+            TSAN.read("GatewayServer._tenants", self)
             tenant = self._tenants.get(item.tenant_name)
             if tenant is None:
                 self._finish(
@@ -633,12 +662,14 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                 continue
             tenant.last_active = time.monotonic()
             req_id = payload.get("req_id")
+            TSAN.read("GatewayServer.tenant_ledgers", tenant)
             cached = tenant.reply_cache.get(req_id) if req_id else None
             if cached is not None:
                 # Idempotent re-ask after a lost reply: hand back the SAME
                 # suggestions — no second RNG draw, no forked stream.
-                tenant.suggests += 1
                 with self._lock:
+                    TSAN.write("GatewayServer.tenant_counters", self)
+                    tenant.suggests += 1
                     self._stats["suggests"] += 1
                 self._finish(item, cached)
                 continue
@@ -690,8 +721,9 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                     f"original of re-asked suggest {req_id!r} cached no reply"
                 )
             else:
-                tenant.suggests += 1
                 with self._lock:
+                    TSAN.write("GatewayServer.tenant_counters", self)
+                    tenant.suggests += 1
                     self._stats["suggests"] += 1
             self._finish(item, reply)
 
@@ -773,6 +805,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
 
     def _book_dispatch(self, width):
         with self._lock:
+            TSAN.write("GatewayServer.tenant_counters", self)
             self._stats["dispatches"] += 1
             if width > 1:
                 self._stats["coalesced_dispatches"] += 1
@@ -809,12 +842,15 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             result["params"] = params
         result["health"] = self._health_fields(job)
         reply = ok_reply(result)
-        if not optout:
-            # Opt-outs are NOT cached: the producer's re-ask after a
-            # backoff is a genuinely new question against fresher state.
-            tenant.cache_reply(payload.get("req_id"), reply)
-        tenant.suggests += 1
         with self._lock:
+            if not optout:
+                # Opt-outs are NOT cached: the producer's re-ask after a
+                # backoff is a genuinely new question against fresher
+                # state.  Cached under the gateway lock: _write_snapshot
+                # reads the reply ledger from the shutdown thread.
+                tenant.cache_reply(payload.get("req_id"), reply)
+            TSAN.write("GatewayServer.tenant_counters", self)
+            tenant.suggests += 1
             self._stats["suggests"] += 1
         TELEMETRY.count("serve.suggests")
         if TELEMETRY.enabled:
@@ -833,6 +869,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             health = dict(job.exec_algo.health_record() or {})
         except Exception:  # pragma: no cover - observability never breaks serve
             health = {}
+        TSAN.read("GatewayServer._tenants", self)
         health["serve_width"] = job.width
         health["serve_queue_depth"] = self._queue.qsize()
         health["serve_tenants"] = len(self._tenants)
@@ -841,6 +878,8 @@ class GatewayServer(socketserver.ThreadingTCPServer):
     # --- stats ----------------------------------------------------------------
     def stats_snapshot(self):
         with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
+            TSAN.read("GatewayServer.tenant_counters", self)
             stats = {
                 key: (dict(value) if isinstance(value, dict) else value)
                 for key, value in self._stats.items()

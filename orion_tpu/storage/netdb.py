@@ -40,6 +40,7 @@ from orion_tpu.health import FLIGHT
 from orion_tpu.storage.backends import atomic_pickle_dump
 from orion_tpu.storage.documents import MemoryDB
 from orion_tpu.telemetry import TELEMETRY
+from orion_tpu.analysis.sanitizer import TSAN
 from orion_tpu.utils.exceptions import (
     AuthenticationError,
     DatabaseError,
@@ -369,7 +370,13 @@ class DBServer(socketserver.ThreadingTCPServer):
         with self._persist_lock:
             # Hold the DB lock while pickling: handler threads mutate the
             # collections concurrently and pickle iterating a changing dict
-            # raises mid-dump.
+            # raises mid-dump.  The static resolver cannot see this edge
+            # (the lock lives on the attribute-held db object), so the
+            # runtime sanitizer's cross-check anchors its LCK003 here:
+            # the ordering is one-directional by construction — no MemoryDB
+            # op calls back into the server, so persist_lock is always the
+            # outer lock.  Pinned by tests/fixtures/lint/tsan_edge_cases.py.
+            # lint: disable=LCK003 -- one-directional flusher edge; persist_lock always outer
             with self.db._lock:
                 atomic_pickle_dump(self.persist, self.db)
 
@@ -476,6 +483,7 @@ class NetworkDB:
 
     # --- wire ----------------------------------------------------------------
     def _connect(self):
+        TSAN.write("NetworkDB._conn", self)
         self._close()
         sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
         if self._ever_connected:
@@ -535,6 +543,7 @@ class NetworkDB:
             )
 
     def _close(self):
+        TSAN.write("NetworkDB._conn", self)
         for closer in (self._file, self._sock):
             if closer is not None:
                 try:
@@ -542,6 +551,14 @@ class NetworkDB:
                 except OSError:  # pragma: no cover
                     pass
         self._sock = self._file = None
+
+    def close(self):
+        """Public teardown: ``_close`` is the internal caller-holds-_lock
+        form — external owners (bench, tests, pools) must come through the
+        lock or a concurrent request could race the socket teardown (the
+        runtime sanitizer flags the bare form)."""
+        with self._lock:
+            self._close()
 
     def __getstate__(self):
         # Sockets don't cross fork/pickle; children reconnect lazily.
@@ -566,6 +583,7 @@ class NetworkDB:
         Round-trip latency feeds the ``storage.network.rtt`` telemetry
         histogram when the registry is enabled."""
         t0 = time.perf_counter() if TELEMETRY.enabled else None
+        TSAN.write("NetworkDB._conn", self)
         self._sock.sendall(payload)
         response = _read_line(self._file)
         if response is None:

@@ -37,6 +37,11 @@ import threading
 import time
 import weakref
 
+# Annotated-cell hooks for the runtime concurrency sanitizer
+# (orion-tpu tsan): one attribute check when disabled, constant-string
+# args — the same cost discipline the registry itself keeps.
+from orion_tpu.analysis.sanitizer import TSAN
+
 _ENABLE_VALUES = ("1", "on", "true", "yes")
 
 #: Histogram shape: bucket ``i`` counts durations in ``[2**(i-1), 2**i)``
@@ -153,6 +158,7 @@ class Telemetry:
         if not self.enabled:
             return
         with self._lock:
+            TSAN.write("Telemetry._metrics", self)
             self._counters[name] = self._counters.get(name, 0) + int(n)
 
     def counter_value(self, name, default=0):
@@ -161,6 +167,7 @@ class Telemetry:
         boundary-crossing tests and ``bench.py --smoke`` check
         ``jax.retraces``/``jax.prewarms`` deltas through this."""
         with self._lock:
+            TSAN.read("Telemetry._metrics", self)
             return self._counters.get(name, default)
 
     def set_gauge(self, name, value):
@@ -168,6 +175,7 @@ class Telemetry:
         if not self.enabled:
             return
         with self._lock:
+            TSAN.write("Telemetry._metrics", self)
             self._gauges[name] = float(value)
 
     def observe(self, name, seconds):
@@ -182,6 +190,7 @@ class Telemetry:
         """THE histogram update — callers hold the registry lock.  Shared
         by observe() and record_span() so the two sample sources can never
         drift apart."""
+        TSAN.write("Telemetry._metrics", self)
         hist = self._histograms.get(name)
         if hist is None:
             hist = [[0] * N_BUCKETS, 0, 0.0, seconds, seconds]
@@ -202,11 +211,13 @@ class Telemetry:
         except TypeError:  # pragma: no cover - exotic objects without weakref
             return
         with self._lock:
+            TSAN.write("Telemetry._metrics", self)
             self._external.setdefault(name, []).append((ref, attr))
 
     def _external_counts(self):
         out = {}
         with self._lock:
+            TSAN.write("Telemetry._metrics", self)  # prunes dead registrations
             for name, entries in list(self._external.items()):
                 live = [(ref, attr) for ref, attr in entries if ref() is not None]
                 if not live:
@@ -251,6 +262,7 @@ class Telemetry:
                 name, start, duration, args, time.perf_counter()
             )
             with self._lock:
+                TSAN.write("Telemetry._ring", self)
                 self._ring[self._seq % self._capacity] = record
                 self._seq += 1
                 if histogram:
@@ -298,6 +310,7 @@ class Telemetry:
                 for name, start, duration, args in entries
             ]
             with self._lock:
+                TSAN.write("Telemetry._ring", self)
                 for name, record, duration in records:
                     self._ring[self._seq % self._capacity] = record
                     self._seq += 1
@@ -309,6 +322,7 @@ class Telemetry:
         """Every span currently in the ring, oldest first (wraparound has
         dropped anything older than ``capacity`` records)."""
         with self._lock:
+            TSAN.read("Telemetry._ring", self)
             start = max(0, self._seq - self._capacity)
             return [self._ring[i % self._capacity] for i in range(start, self._seq)]
 
@@ -317,6 +331,7 @@ class Telemetry:
         exactly once — the worker flush channel).  Wraparound between
         drains loses the overwritten oldest records, by design."""
         with self._lock:
+            TSAN.write("Telemetry._ring", self)  # advances the drain cursor
             start = max(self._drained, self._seq - self._capacity)
             out = [self._ring[i % self._capacity] for i in range(start, self._seq)]
             self._drained = self._seq
@@ -329,6 +344,7 @@ class Telemetry:
         through ``DocumentStorage.record_metrics`` every round."""
         external = self._external_counts()
         with self._lock:
+            TSAN.read("Telemetry._metrics", self)
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             histograms = {
@@ -351,6 +367,8 @@ class Telemetry:
         monotonic txn/wire totals must not bleed into a fresh measurement;
         a backend created after the reset re-registers on construction)."""
         with self._lock:
+            TSAN.write("Telemetry._metrics", self)
+            TSAN.write("Telemetry._ring", self)
             self._counters.clear()
             self._gauges.clear()
             self._histograms.clear()

@@ -46,6 +46,38 @@ def repo_root():
 
 
 @pytest.fixture(autouse=True)
+def _tsan_marked_tests(request):
+    """The tsan pytest plugin: a test marked ``@pytest.mark.tsan`` runs
+    under the runtime concurrency sanitizer (instrumented lock/event shims,
+    vector-clock race detection, the seeded interleaving explorer) and
+    FAILS if the run observed any data race or lock-order cycle — the
+    tier-1 dynamic leg of the static LCK rules.  Marked tests must not
+    enable/disable the sanitizer themselves (the fixture owns it); tests
+    that exercise the sanitizer's own machinery stay unmarked."""
+    marker = request.node.get_closest_marker("tsan")
+    if marker is None:
+        yield
+        return
+    from orion_tpu.analysis.sanitizer import TSAN
+
+    if TSAN.enabled:
+        # The whole pytest process is already instrumented (`orion-tpu
+        # tsan -- pytest ...`): the outer owner collects and reports at
+        # exit; enabling again would raise and unpatching mid-run would
+        # blind it.
+        yield
+        return
+    TSAN.enable(seed=int(marker.kwargs.get("seed", 0)))
+    try:
+        yield
+    finally:
+        report = TSAN.disable()
+    assert report.violation_count() == 0, (
+        "tsan violations in a tsan-marked test:\n" + report.format_human()
+    )
+
+
+@pytest.fixture(autouse=True)
 def _isolate_user_config(tmp_path, monkeypatch):
     """Tests must never inherit the developer's ~/.config/orion_tpu."""
     monkeypatch.setenv("XDG_CONFIG_HOME", str(tmp_path / "xdg-isolated"))

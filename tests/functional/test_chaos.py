@@ -80,7 +80,7 @@ def _make_faulty_storage(backend, tmp_path, schedule):
     storage = DocumentStorage(client, retry=RETRY)
 
     def cleanup():
-        client._close()
+        client.close()
         proxy.stop()
         server.shutdown()
         server.server_close()

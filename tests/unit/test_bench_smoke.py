@@ -71,6 +71,10 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     # The --smoke preflight self-lints the tree before timing anything:
     # bench numbers must never be taken on a contract-violating tree.
     assert payload["lint_violations"] == 0
+    # The serve leg ran under the runtime concurrency sanitizer (orion-tpu
+    # tsan): zero observed data races and zero lock-order cycles is a hard
+    # assert inside bench.py; this pins the payload field on top.
+    assert payload["tsan_violations"] == 0
     # The emitted line itself must carry the breakdown + storage keys —
     # r05's recorded line lacked them, and only an assertion on the payload
     # (not just on values we happen to index) pins the schema.

@@ -37,7 +37,7 @@ def proxied_netdb():
     try:
         yield db, server, proxy
     finally:
-        db._close()
+        db.close()
         proxy.stop()
         server.shutdown()
         server.server_close()

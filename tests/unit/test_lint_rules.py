@@ -22,9 +22,11 @@ _FIXTURE_DIR = os.path.join(
     "lint",
 )
 
-#: Handled by dedicated tests below, not the annotation table (its
-#: reasonless disable comment cannot carry an expect annotation too).
-_TABLE_EXCLUDED = {"malformed_suppression.py"}
+#: Handled by dedicated tests below, not the annotation table
+#: (malformed_suppression's reasonless disable cannot carry an expect
+#: annotation too; tsan_edge_cases needs a runtime-edge report supplied —
+#: LCK003 must stay silent on the plain run the table performs).
+_TABLE_EXCLUDED = {"malformed_suppression.py", "tsan_edge_cases.py"}
 
 _TABLE_FIXTURES = sorted(
     name
@@ -76,13 +78,16 @@ def test_fixture_produces_exactly_the_annotated_diagnostics(fixture):
 
 
 def test_every_rule_family_is_covered_by_a_fixture():
-    """The table must exercise all four families (plus stay honest if a
-    rule is added without a fixture: its id must appear in some
-    annotation)."""
+    """The fixtures must exercise every registered rule (stay honest if a
+    rule is added without one: its id must appear in some annotation).
+    Scans ALL fixtures, including the table-excluded ones driven by
+    dedicated tests (tsan_edge_cases pins LCK003)."""
     from orion_tpu.analysis import rule_catalog
 
     annotated = set()
-    for fixture in _TABLE_FIXTURES:
+    for fixture in os.listdir(_FIXTURE_DIR):
+        if not fixture.endswith(".py"):
+            continue
         for ids in _expected_diagnostics(
             os.path.join(_FIXTURE_DIR, fixture)
         ).values():
@@ -561,6 +566,78 @@ def test_jit003_wrapper_binding_is_the_call_site_name(tmp_path):
         "    return fast(2.5, 3)\n"
     )
     assert [(d.rule_id, d.line) for d in run_lint([str(src)])] == [("JIT003", 7)]
+
+
+def test_lck003_fires_on_runtime_edge_the_static_graph_lacks(tmp_path):
+    """The static<->dynamic feedback loop: a lock-order edge the runtime
+    sanitizer observed between two statically-declared locks that the
+    static graph never derived is an LCK003 at the observed acquisition
+    site; an observed edge the graph ALREADY models stays quiet, as does
+    one whose endpoints the linted tree does not declare.  The fixture
+    mirrors the first real feedback case (netdb's snapshot flusher holding
+    DBServer._persist_lock while taking the attribute-held MemoryDB._lock,
+    argued there with a suppression)."""
+    from orion_tpu.analysis import run_lint
+    from orion_tpu.analysis.sanitizer import set_lint_runtime_edges
+
+    path = os.path.join(_FIXTURE_DIR, "tsan_edge_cases.py")
+    expected = _expected_diagnostics(path)
+    assert expected, "fixture lost its expect annotation"
+    (lck003_line,) = [
+        line for line, ids in expected.items() if "LCK003" in ids
+    ]
+
+    # Without a runtime report the rule is silent (the plain-table premise).
+    assert run_lint([path], select=["LCK"]) == []
+
+    edges = [
+        # The resolver blind spot: inner lock reached through self.db.
+        {
+            "outer": "Server._persist_lock",
+            "inner": "Store._lock",
+            "path": path,
+            "line": lck003_line,
+        },
+        # Statically modeled nesting: observed at runtime too, no finding.
+        {
+            "outer": "Server._persist_lock",
+            "inner": "tsan_edge_cases.OTHER",
+            "path": path,
+            "line": lck003_line,
+        },
+        # Endpoints the linted tree does not declare: report came from
+        # other code, nothing to extend here.
+        {
+            "outer": "Elsewhere._lock",
+            "inner": "Other._lock",
+            "path": path,
+            "line": lck003_line,
+        },
+    ]
+    set_lint_runtime_edges(edges)
+    try:
+        findings = [
+            (d.rule_id, d.line) for d in run_lint([path], select=["LCK"])
+        ]
+        assert findings == [("LCK003", lck003_line)]
+
+        # A suppression at the acquisition site argues the edge away —
+        # the netdb flusher's shape (re-anchored onto the linted path even
+        # when the runtime report carried an absolute path).
+        source = open(path).read()
+        suppressed = tmp_path / "suppressed.py"
+        suppressed.write_text(
+            source.replace(
+                "            with self.db._lock:  # expect: LCK003\n",
+                "            # lint: disable=LCK003 -- test: one-directional\n"
+                "            with self.db._lock:  # expect: LCK003\n",
+            )
+        )
+        abs_edges = [dict(edges[0], path=str(suppressed), line=lck003_line + 1)]
+        set_lint_runtime_edges(abs_edges)
+        assert run_lint([str(suppressed)], select=["LCK"]) == []
+    finally:
+        set_lint_runtime_edges(None)
 
 
 def test_lck001_sees_context_managed_callee_under_lock(tmp_path):

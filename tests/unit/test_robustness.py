@@ -634,6 +634,6 @@ def test_maybe_applied_marker_survives_the_wire():
         # And the fault DID apply server-side.
         assert server.db.read("docs", {"_id": 1})[0]["v"] == 1
     finally:
-        client._close()
+        client.close()
         server.shutdown()
         server.server_close()
