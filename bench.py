@@ -449,7 +449,7 @@ def bench_prewarm(q=16):
 
 
 def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
-                fit_steps=4):
+                fit_steps=4, storage=None):
     """The multi-tenant suggest gateway, full stack (orion_tpu.serve):
     M concurrent experiments — each a REAL producer/worker loop over one
     shared sqlite store, its algorithm a gateway-backed RemoteAlgorithm —
@@ -488,9 +488,10 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
     errors, reports = [], {}
     try:
         with tempfile.TemporaryDirectory(prefix="orion-bench-serve-") as tmp:
-            storage = create_storage(
-                {"type": "sqlite", "path": os.path.join(tmp, "serve.sqlite")}
-            )
+            if storage is None:
+                storage = create_storage(
+                    {"type": "sqlite", "path": os.path.join(tmp, "serve.sqlite")}
+                )
 
             def run_tenant(index):
                 try:
@@ -589,18 +590,103 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
     }
 
 
-def main_serve(m_tenants=4, rounds=6, q=16):
+def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
     """``bench.py --serve``: the gateway serving M concurrent experiments —
     prints ONE json line with the coalesce/latency/dispatch-amortization
-    numbers (hard asserts inside bench_serve)."""
+    numbers (hard asserts inside bench_serve).
+
+    ``--serve --smoke`` runs the tenants over a LOOPBACK NETDB store so
+    every hop crosses a real wire, exports the merged distributed trace
+    (``bench_serve_trace.json``), and hard-asserts the ISSUE-11 acceptance:
+    a RemoteAlgorithm suggest, the gateway's coalesced dispatch (link),
+    and the storage commit's server-side apply joined by trace_id, with
+    cross-process flow events in the Perfetto file."""
+    if not smoke:
+        payload = {
+            "metric": "serve gateway smoke",
+            "serve": bench_serve(
+                m_tenants=m_tenants, rounds=rounds, q=q, n_candidates=1024,
+                fit_steps=8,
+            ),
+        }
+        print(json.dumps(payload))
+        return
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.storage.base import DocumentStorage
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+    from orion_tpu.tracing import SERVER_EXPERIMENT
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    db_server = DBServer(port=0)
+    host, port = db_server.serve_background()
+    net_db = NetworkDB(host=host, port=port)
+    try:
+        serve_block = bench_serve(
+            m_tenants=2, rounds=3, q=8, window=0.4, n_candidates=256,
+            fit_steps=4, storage=DocumentStorage(net_db),
+        )
+        db_server.flush_server_spans(force=True)
+        server_spans = DocumentStorage(net_db).fetch_spans(SERVER_EXPERIMENT)
+    finally:
+        net_db.close()
+        db_server.shutdown()
+        db_server.server_close()
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    spans = [s for s in tel.TELEMETRY.iter_spans() if s] + list(server_spans)
+    trace_path = "bench_serve_trace.json"
+    tel.write_chrome_trace(trace_path, spans)
+    joined = assert_joined_serve_trace(spans)
     payload = {
-        "metric": "serve gateway smoke",
-        "serve": bench_serve(
-            m_tenants=m_tenants, rounds=rounds, q=q, n_candidates=1024,
-            fit_steps=8,
-        ),
+        "metric": "serve gateway smoke (distributed trace)",
+        "serve": serve_block,
+        "serve_trace_file": trace_path,
+        "trace": joined,
     }
     print(json.dumps(payload))
+
+
+def assert_joined_serve_trace(spans):
+    """The ISSUE-11 end-to-end join, hard-gated (SystemExit, not assert —
+    must hold under ``python -O``): at least one trace_id carries BOTH the
+    client's ``serve.client.suggest`` span and the netdb server's
+    ``netdb.apply`` span AND is linked by a gateway ``serve.dispatch``
+    span; the exported events contain >= 1 bound ``s``/``f`` flow pair."""
+    from orion_tpu.telemetry import chrome_trace_events
+
+    by_trace = {}
+    for span in spans:
+        trace_id = span.get("trace_id")
+        if trace_id:
+            by_trace.setdefault(trace_id, set()).add(span.get("name"))
+    linked = set()
+    for span in spans:
+        if span.get("name") != "serve.dispatch":
+            continue
+        for link in span.get("links") or ():
+            linked.add((link or {}).get("trace_id"))
+    joined = [
+        trace_id
+        for trace_id, names in by_trace.items()
+        if "serve.client.suggest" in names
+        and "netdb.apply" in names
+        and trace_id in linked
+    ]
+    if not joined:
+        raise SystemExit(
+            "distributed serve trace is NOT joined: no trace_id carries "
+            "serve.client.suggest + netdb.apply + a serve.dispatch link "
+            f"(traces seen: {len(by_trace)}, linked: {len(linked)})"
+        )
+    events = chrome_trace_events(spans)
+    starts = {e["id"] for e in events if e.get("ph") == "s"}
+    finishes = {e["id"] for e in events if e.get("ph") == "f"}
+    flow_pairs = len(starts & finishes)
+    if not flow_pairs:
+        raise SystemExit("no cross-process flow events in the serve trace")
+    return {"joined_traces": len(joined), "flow_pairs": flow_pairs}
 
 
 def bench_trace(out_path, rounds=3, q=16):
@@ -615,6 +701,15 @@ def bench_trace(out_path, rounds=3, q=16):
     ``jax.suggest_step.compile`` (first call, retrace) and
     ``jax.suggest_step.dispatch`` (second call, cache hit) spans.
 
+    A DISTRIBUTED leg then runs the same producer rounds over a loopback
+    netdb server, so every round's trace crosses a real wire: the server's
+    adopted ``netdb.apply`` spans are fetched back through the
+    ``__server__`` channel, merged by trace_id, and the exported file
+    carries cross-process flow arrows.  The merged spans feed the
+    critical-path attribution (``orion_tpu.tracing``): each round's wall
+    time bucketed into client-host / wire / server-host / device — the
+    ROADMAP item-2 burn-down number.  Returns ``(path, host_attribution)``.
+
     Telemetry is enabled ONLY inside this phase, so the timed benches above
     keep measuring the disabled-path cost (the production default)."""
     import os
@@ -623,39 +718,66 @@ def bench_trace(out_path, rounds=3, q=16):
     from orion_tpu import telemetry as tel
     from orion_tpu.core.experiment import build_experiment
     from orion_tpu.core.producer import Producer
-    from orion_tpu.storage.base import create_storage
+    from orion_tpu.storage.base import DocumentStorage, create_storage
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+    from orion_tpu.tracing import SERVER_EXPERIMENT, summarize_attribution
+
+    def run_rounds(storage, name):
+        experiment = build_experiment(
+            storage,
+            name,
+            priors={f"x{i}": "uniform(0, 1)" for i in range(4)},
+            algorithms={"random": {"seed": SEED}},
+            metadata={"user": "bench"},
+        )
+        experiment.instantiate(seed=SEED)
+        producer = Producer(experiment)
+        for _ in range(rounds):
+            producer.update()
+            producer.produce(q)
+        producer._flush_timings(force_metrics=True)
 
     was_enabled = tel.TELEMETRY.enabled
     tel.TELEMETRY.enable()
+    phase_t0 = time.time()
     try:
         with tempfile.TemporaryDirectory(prefix="orion-bench-trace-") as tmpdir:
             storage = create_storage(
                 {"type": "sqlite", "path": os.path.join(tmpdir, "trace.sqlite")}
             )
-            experiment = build_experiment(
-                storage,
-                "bench-trace",
-                priors={f"x{i}": "uniform(0, 1)" for i in range(4)},
-                algorithms={"random": {"seed": SEED}},
-                metadata={"user": "bench"},
-            )
-            experiment.instantiate(seed=SEED)
-            producer = Producer(experiment)
-            for _ in range(rounds):
-                producer.update()
-                producer.produce(q)
-            producer._flush_timings(force_metrics=True)
+            run_rounds(storage, "bench-trace")
+        # Distributed leg: loopback netdb — the round's storage commits
+        # carry the trace context over the wire and come back joined.
+        server = DBServer(port=0)
+        host, port = server.serve_background()
+        net_db = NetworkDB(host=host, port=port)
+        try:
+            run_rounds(DocumentStorage(net_db), "bench-trace-dist")
+            server.flush_server_spans(force=True)
+            server_spans = DocumentStorage(net_db).fetch_spans(SERVER_EXPERIMENT)
+        finally:
+            net_db.close()
+            server.shutdown()
+            server.server_close()
         algo = _make_algo(seed=SEED + 4, n_candidates=256, fit_steps=4)
         rng = np.random.default_rng(SEED + 4)
         X = rng.uniform(size=(16, 6)).astype(np.float32)
         _observe(algo, X, _hartmann6_np(X))
         algo.suggest(8)  # compile -> jax.suggest_step.compile span
         algo.suggest(8)  # cache hit -> jax.suggest_step.dispatch span
-        tel.TELEMETRY.export_chrome_trace(out_path)
+        spans = [s for s in tel.TELEMETRY.iter_spans() if s] + list(server_spans)
+        # The exported FILE keeps everything the ring holds (earlier phases
+        # like the serve leg included — their cross-track flows are part of
+        # the artifact); the ATTRIBUTION covers only THIS phase's rounds, so
+        # an earlier leg's deliberately-slow coalescing windows cannot skew
+        # the round split.
+        phase_spans = [s for s in spans if float(s.get("ts") or 0.0) >= phase_t0]
+        attribution = summarize_attribution(phase_spans, root_name="producer.round")
+        tel.write_chrome_trace(out_path, spans)
     finally:
         if not was_enabled:
             tel.TELEMETRY.disable()
-    return out_path
+    return out_path, attribution
 
 
 def bench_device_decomposition():
@@ -739,6 +861,10 @@ def _json_payload(
         # Multi-seed regret-trajectory gate verdict
         # (orion_tpu.benchmarks.regret_gate vs BENCH_REGRET_BASELINE.json).
         "regret_gate": regret_gate,
+        # Distributed-trace critical-path split of the traced producer
+        # rounds (orion_tpu.tracing, mean ms per round): client-host /
+        # wire / server-host / device — stamped by _safe_trace.
+        "host_attribution": None,
     }
     if smoke:
         payload["smoke"] = True
@@ -802,7 +928,7 @@ def main(smoke=False, trace_out="bench_trace.json"):
         f"regret parity failed: ours={ours_regret:.6f} "
         f"anchor={anchor_regret:.6f} tol={REGRET_TOL}"
     )
-    trace_file = _safe_trace(trace_out)
+    trace_file, host_attribution = _safe_trace(trace_out)
     payload = _json_payload(
         metric=(
             "suggestions/sec @ q=1024, Hartmann6 "
@@ -822,19 +948,42 @@ def main(smoke=False, trace_out="bench_trace.json"):
         regret_gate=gate,
     )
     payload["trace_file"] = trace_file
+    payload["host_attribution"] = host_attribution
+    _warn_host_budget(payload)
     print(json.dumps(payload))
 
 
 def _safe_trace(trace_out):
     """Run the trace phase; a tracing failure must cost the bench its
-    artifact, never its numbers."""
+    artifact (and attribution block), never its numbers.  Returns
+    ``(path, host_attribution)``."""
     import traceback
 
     try:
         return bench_trace(trace_out)
     except Exception:
         traceback.print_exc()
-        return None
+        return None, None
+
+
+def _warn_host_budget(payload):
+    """ROADMAP item-2 watchdog: WARN (never fail) when the steady-state
+    host tax exceeds 2× device time — the attribution block says where the
+    excess lives."""
+    import sys
+
+    host = payload.get("host_ms_per_round")
+    device = payload.get("device_ms_per_round")
+    if host is None or not device:
+        return
+    if host > 2.0 * device:
+        print(
+            f"WARNING: host_ms_per_round={host} exceeds the ROADMAP item-2 "
+            f"target of 2x device_ms_per_round={device} — see the "
+            "host_attribution block for the client-host/wire/server-host/"
+            "device split",
+            file=sys.stderr,
+        )
 
 
 def main_chaos(rounds=6, q=8, seed=11):
@@ -1031,7 +1180,7 @@ def main_smoke(trace_out="bench_trace.json"):
             "serve leg failed the concurrency sanitizer:\n"
             + tsan_report.format_human()
         )
-    trace_file = _safe_trace(trace_out)
+    trace_file, host_attribution = _safe_trace(trace_out)
     payload = _json_payload(
         metric=(
             f"SMOKE (q={q}): schema check only — run without "
@@ -1052,9 +1201,11 @@ def main_smoke(trace_out="bench_trace.json"):
         smoke=True,
     )
     payload["trace_file"] = trace_file
+    payload["host_attribution"] = host_attribution
     payload["lint_violations"] = lint_violations
     payload["tsan_violations"] = tsan_report.violation_count()
     payload["serve"] = serve_block
+    _warn_host_budget(payload)
     print(json.dumps(payload))
 
 
@@ -1071,6 +1222,6 @@ if __name__ == "__main__":
     if "--chaos" in argv:
         main_chaos()
     elif "--serve" in argv:
-        main_serve()
+        main_serve(smoke="--smoke" in argv)
     else:
         main(smoke="--smoke" in argv, trace_out=out)

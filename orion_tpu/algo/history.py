@@ -34,11 +34,15 @@ A bench- or client-driven algorithm that is never cloned donates on every
 append.
 """
 
+import threading
+import weakref
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from orion_tpu.telemetry import TELEMETRY
 
 
 def _next_pow2(n, floor=64):
@@ -46,6 +50,50 @@ def _next_pow2(n, floor=64):
     while out < n:
         out *= 2
     return out
+
+
+# --- memory accounting -------------------------------------------------------
+# Live-instance registries (weak — registration must never extend a
+# history's lifetime) feeding the device-memory sampler
+# (orion_tpu.devmem): per-pow-2-bucket resident device bytes and the host
+# mirror total.  Clones made by __deepcopy__ share buffers with their
+# source and are deliberately NOT registered (they bypass __init__), so
+# shared buffers are counted once.
+_registry_lock = threading.Lock()
+_device_histories = weakref.WeakSet()
+_host_histories = weakref.WeakSet()
+
+
+def history_memory_stats():
+    """Resident observation-history bytes, introspected from every live
+    (non-clone) history instance: ``device_buckets`` maps pow-2 capacity
+    -> total device bytes at that bucket, ``device_bytes``/``host_bytes``
+    the totals, ``device_count`` live DeviceHistory instances."""
+    with _registry_lock:
+        device = list(_device_histories)
+        host = list(_host_histories)
+    buckets = {}
+    device_bytes = 0
+    for hist in device:
+        if not hist.cap or hist._x is None:
+            continue
+        nbytes = 0
+        for buf in (hist._x, hist._y, hist._mask):
+            try:
+                nbytes += int(buf.nbytes)
+            except Exception:  # pragma: no cover - deleted buffer mid-walk
+                pass
+        buckets[hist.cap] = buckets.get(hist.cap, 0) + nbytes
+        device_bytes += nbytes
+    host_bytes = sum(
+        int(h._x.nbytes) + int(h._y.nbytes) for h in host
+    )
+    return {
+        "device_buckets": buckets,
+        "device_bytes": device_bytes,
+        "host_bytes": host_bytes,
+        "device_count": len(device),
+    }
 
 
 #: Append batches are padded to a power of 2 (floor 8) so the update jit
@@ -143,6 +191,8 @@ class DeviceHistory:
         # True while the buffers may be visible to another DeviceHistory
         # (a naive-copy clone): the next append must not donate them.
         self._cow = False
+        with _registry_lock:
+            _device_histories.add(self)
 
     @classmethod
     def from_host(cls, x, y, floor=64):
@@ -202,10 +252,14 @@ class DeviceHistory:
         # out-of-range starts, which would silently shift the write onto
         # valid rows.
         self._ensure_capacity(self.count + b_pad)
-        fn = (
-            _append_donating
-            if not self._cow and _donation_supported()
-            else _append_copying
+        donated = not self._cow and _donation_supported()
+        fn = _append_donating if donated else _append_copying
+        # Donation-hit accounting (orion_tpu.devmem): how often the append
+        # aliased the resident buffers vs paid an O(capacity) copy (CoW
+        # after a naive clone, or a CPU backend).  Constant names, one
+        # enabled check — hot-path clean.
+        TELEMETRY.count(
+            "history.appends.donated" if donated else "history.appends.copied"
         )
         self._x, self._y, self._mask = fn(
             self._x,
@@ -281,6 +335,8 @@ class HostHistory:
         self._cow = False
         self.best_idx = -1
         self.best_y = np.inf
+        with _registry_lock:
+            _host_histories.add(self)
 
     @classmethod
     def from_host(cls, x, y, floor=64):

@@ -26,6 +26,7 @@ suggest call actually paid (the channel the boundary-crossing test and
 import logging
 import threading
 import time
+import weakref
 
 # Module-scope on purpose (cycle-free: history.py imports nothing from this
 # package): the bucket planners below AND the serve gateway's coalescer both
@@ -62,6 +63,26 @@ def completed_prewarm_count():
     with _completed_lock:
         TSAN.read("prewarm._completed_count")
         return _completed_count
+
+
+# Live-prewarmer registry (weak) feeding the device-memory sampler
+# (orion_tpu.devmem): the prewarm INVENTORY — how many distinct signatures
+# have been launched across every live prewarmer, next to the process-wide
+# completed count.
+_prewarmers_lock = threading.Lock()
+_prewarmers = weakref.WeakSet()
+
+
+def prewarm_inventory():
+    """``{"started", "completed"}``: distinct signatures launched across
+    every live :class:`BucketPrewarmer`, and compiles finished
+    process-wide."""
+    with _prewarmers_lock:
+        live = list(_prewarmers)
+    return {
+        "started": sum(p.started_count() for p in live),
+        "completed": completed_prewarm_count(),
+    }
 
 
 def _note_prewarm_completed():
@@ -139,6 +160,8 @@ class BucketPrewarmer:
         self._threads = {}
         self._lock = threading.Lock()
         self._completed = 0
+        with _prewarmers_lock:
+            _prewarmers.add(self)
 
     def maybe_start(self, key, compile_fn):
         """Run ``compile_fn`` on a background thread unless ``key`` was
@@ -177,6 +200,13 @@ class BucketPrewarmer:
         # — the args dict must not allocate when the recorder is off.
         if FLIGHT.enabled:
             FLIGHT.record("jax.prewarm", args={"key": str(key)})
+
+    def started_count(self):
+        """Distinct signatures this instance has launched — the prewarm
+        INVENTORY leg of :func:`prewarm_inventory`."""
+        with self._lock:
+            TSAN.read("BucketPrewarmer._threads", self)
+            return len(self._started)
 
     def completed_count(self):
         """Prewarm attempts THIS instance finished (success or failure) —

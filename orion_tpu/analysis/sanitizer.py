@@ -430,6 +430,12 @@ class Tsan:
         ("orion_tpu.telemetry", "TELEMETRY", "_lock", "Telemetry._lock"),
         ("orion_tpu.health", "FLIGHT", "_lock", "FlightRecorder._lock"),
         ("orion_tpu.algo.prewarm", None, "_completed_lock", "prewarm._completed_lock"),
+        ("orion_tpu.algo.prewarm", None, "_prewarmers_lock", "prewarm._prewarmers_lock"),
+        ("orion_tpu.algo.history", None, "_registry_lock", "history._registry_lock"),
+        # The memory sampler's rate-limit cell and the worker metrics-server
+        # singleton guard (both annotated shared cells).
+        ("orion_tpu.devmem", None, "_lock", "devmem._lock"),
+        ("orion_tpu.metrics", None, "_worker_lock", "metrics._worker_lock"),
     )
 
     def __init__(self):

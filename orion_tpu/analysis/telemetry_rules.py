@@ -495,9 +495,112 @@ class HealthEmissionOnDisabledPath(Rule):
             )
 
 
+#: Attribute names whose CALL marks a function as a wire-send path: the
+#: socket send itself, the request/response exchange helpers, and the
+#: gateway reply writer (``self.wfile.write``).
+_WIRE_SEND_ATTRS = ("sendall",)
+_WIRE_EXCHANGE_PREFIX = "_exchange"
+
+#: Referenced names/attributes that count as touching the TraceContext
+#: machinery (injecting into a payload, adopting off the wire, or stamping
+#: a span's trace identity explicitly).
+_CTX_NAME_MARKERS = frozenset(
+    {
+        "TraceContext",
+        "current_trace_context",
+        "set_trace_context",
+        "trace_scope",
+        "to_wire",
+        "from_wire",
+        "adopt_begin",
+        "adopt_finish",
+        "ctx",
+    }
+)
+_CTX_KEYWORD_MARKERS = frozenset({"ctx", "span_ctx", "parent_ctx", "links", "root"})
+
+
+def _is_wire_send_call(node):
+    if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+        return False
+    attr = node.func.attr
+    if attr in _WIRE_SEND_ATTRS or attr.startswith(_WIRE_EXCHANGE_PREFIX):
+        return True
+    # The gateway reply writer: ...wfile.write(...)
+    if attr == "write":
+        receiver = dotted_name(node.func.value)
+        return bool(receiver) and receiver.split(".")[-1] == "wfile"
+    return False
+
+
+def _is_span_call(node):
+    """TELEMETRY.span(...) / any ``*.record_span(...)`` — the private
+    server-side registries (``self._span_tel.record_span``) count too."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[-1] == "record_span":
+        return True
+    return len(parts) >= 2 and parts[-2] == "TELEMETRY" and parts[-1] == "span"
+
+
+class WireSpanWithoutTraceContext(Rule):
+    id = "TEL005"
+    name = "wire-span-without-trace-context"
+    description = (
+        "A wire-send path (.sendall / _exchange* / gateway wfile.write) "
+        "that opens or records a span must inject or adopt the ambient "
+        "TraceContext — otherwise the server side of the hop records "
+        "orphan spans and `orion-tpu trace --distributed` cannot join the "
+        "processes (inject: payload['ctx'] = ctx.to_wire(); adopt: "
+        "TraceContext.from_wire(...) / parent_ctx=...)."
+    )
+
+    def check(self, module):
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_wire_send = False
+            span_calls = []
+            touches_ctx = False
+            for node in ast.walk(fn):
+                if _is_wire_send_call(node):
+                    has_wire_send = True
+                if _is_span_call(node):
+                    span_calls.append(node)
+                    if any(
+                        kw.arg in _CTX_KEYWORD_MARKERS for kw in node.keywords
+                    ):
+                        touches_ctx = True
+                name = dotted_name(node) if isinstance(node, ast.Attribute) else None
+                if isinstance(node, ast.Name) and node.id in _CTX_NAME_MARKERS:
+                    touches_ctx = True
+                elif name and any(
+                    part in _CTX_NAME_MARKERS for part in name.split(".")
+                ):
+                    touches_ctx = True
+            if has_wire_send and span_calls and not touches_ctx:
+                for call in span_calls:
+                    yield Diagnostic(
+                        module.path,
+                        call.lineno,
+                        call.col_offset,
+                        self.id,
+                        "span on a wire-send path without TraceContext "
+                        "injection/adoption — the cross-process trace "
+                        "cannot join; inject the ambient context into the "
+                        "payload (ctx.to_wire()) or adopt the wire ctx "
+                        "(TraceContext.from_wire / parent_ctx=...)",
+                    )
+
+
 TELEMETRY_RULES = (
     DynamicKeyInLoop,
     UnmanagedSpan,
     AllocationOnDisabledPath,
     HealthEmissionOnDisabledPath,
+    WireSpanWithoutTraceContext,
 )

@@ -117,6 +117,16 @@ def load_cli_config(args):
         else:
             TELEMETRY.disable()
             FLIGHT.disable()
+    # `metrics_port:` requests the worker-side /metrics + /healthz daemon
+    # (orion_tpu.metrics) — same plane `orion-tpu serve --metrics-port`
+    # attaches to the gateway.  Resolved to the env spelling here (so
+    # `hunt --n-workers` children inherit it too) and STARTED only where a
+    # worker loop actually runs (workon) — read-only commands like `info`
+    # or `top` must not bind the port just because the config names it.
+    if config.get("metrics_port") is not None:
+        os.environ.setdefault(
+            "ORION_TPU_METRICS_PORT", str(int(config["metrics_port"]))
+        )
     return config
 
 

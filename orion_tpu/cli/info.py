@@ -170,16 +170,56 @@ def _telemetry_section(experiment, per_worker=False):
         docs = experiment.storage.fetch_metrics(experiment)
         if not docs:
             return []
+        now = time.time()
         if per_worker:
             lines = [f"workers reporting: {len(docs)}"]
             for doc in docs:
-                lines.append(f"--- worker {doc.get('worker') or '?'}")
+                lines.append(
+                    f"--- worker {doc.get('worker') or '?'}"
+                    + _flush_age_suffix(doc, now)
+                )
                 lines.extend(_snapshot_lines(doc))
             return lines
         merged = merge_snapshots(docs)
-        return [f"workers reporting: {len(docs)}"] + _snapshot_lines(merged)
+        stale = [
+            str(doc.get("worker") or "?")
+            for doc in docs
+            if _flush_age(doc, now) is not None
+            and _flush_age(doc, now) > _stale_after()
+        ]
+        lines = [f"workers reporting: {len(docs)}"] + _snapshot_lines(merged)
+        if stale:
+            # The merged view MAX-combines gauges, so a quiet worker's
+            # numbers survive indefinitely — name who went quiet.
+            lines.append(
+                f"STALE workers (no flush for > {_stale_after():g}s): "
+                + ", ".join(stale)
+            )
+        return lines
     except Exception:
         return []
+
+
+def _stale_after():
+    from orion_tpu.cli.top import STALE_AFTER
+
+    return STALE_AFTER
+
+
+def _flush_age(doc, now):
+    ts = doc.get("time")
+    return round(now - float(ts), 1) if ts else None
+
+
+def _flush_age_suffix(doc, now):
+    """`` (last flush 3.2s ago)`` — with a STALE marker past 3× the
+    metrics flush interval, so the un-merged per-worker blocks carry the
+    liveness signal the MAX-merged view hides."""
+    age = _flush_age(doc, now)
+    if age is None:
+        return ""
+    marker = " STALE" if age > _stale_after() else ""
+    return f" (last flush {age:g}s ago{marker})"
 
 
 def _health_section(experiment, per_worker=False):
@@ -203,8 +243,13 @@ def _health_section(experiment, per_worker=False):
         lines = [f"health records: {len(docs)} from {len(by_worker)} worker(s)"]
         if best is not None:
             lines.append(f"incumbent best_y: {best:.6g}")
+        now = time.time()
         for worker, doc in sorted(by_worker.items()):
             fields = []
+            age = _flush_age(doc, now)
+            if age is not None:
+                marker = " STALE" if age > _stale_after() else ""
+                fields.append(f"age {age:g}s{marker}")
             for key, spec in (
                 ("round", "d"),
                 ("n_obs", "d"),

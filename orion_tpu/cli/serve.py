@@ -69,6 +69,15 @@ def add_subparser(subparsers):
         help="snapshot tenant state (history, trust region, RNG stream) so "
         "a restarted gateway resumes its tenants without client replay",
     )
+    parser.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve /metrics (Prometheus text exposition of the gateway's "
+        "telemetry registry) and /healthz (queue depth, tenant count) on "
+        "this port",
+    )
     parser.set_defaults(func=main)
     return parser
 
@@ -76,6 +85,13 @@ def add_subparser(subparsers):
 def main(args):  # pragma: no cover - thin CLI shim over serve()
     from orion_tpu.serve.gateway import serve
 
+    if args.metrics_port is not None:
+        # Asking for a scrape endpoint IS asking for metrics: a gateway
+        # started with --metrics-port but without ORION_TPU_TELEMETRY
+        # would serve an empty exposition forever.
+        from orion_tpu.telemetry import TELEMETRY
+
+        TELEMETRY.enable()
     serve(
         host=args.host,
         port=args.port,
@@ -86,5 +102,6 @@ def main(args):  # pragma: no cover - thin CLI shim over serve()
         max_q=args.max_q,
         pending_limit=args.pending_limit,
         persist=args.persist,
+        metrics_port=args.metrics_port,
     )
     return 0

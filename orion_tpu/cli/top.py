@@ -19,7 +19,15 @@ from orion_tpu.cli.base import (
     build_from_args,
 )
 
+from orion_tpu.core.producer import Producer
+
 SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+#: A worker whose last metrics/health flush is older than this is marked
+#: STALE: 3× the producer's snapshot-upsert interval — MAX-merged gauges
+#: keep a quiet worker's last numbers alive, so the AGE (not the values)
+#: is the liveness signal.
+STALE_AFTER = 3.0 * Producer.METRICS_FLUSH_INTERVAL
 
 
 def add_subparser(subparsers):
@@ -123,6 +131,9 @@ def snapshot_top(experiment, now=None):
         gauges = doc.get("gauges") or {}
         histograms = doc.get("histograms") or {}
         rounds_hist = histograms.get("producer.round") or {}
+        mem_bytes = gauges.get("memory.device_live_bytes")
+        if mem_bytes is None:
+            mem_bytes = gauges.get("memory.history_device_bytes")
         workers[worker] = {
             "rounds": int(rounds_hist.get("count", 0)),
             "round_rate": None,
@@ -132,7 +143,18 @@ def snapshot_top(experiment, now=None):
             "gave_up": int(counters.get("storage.gave_up", 0)),
             "reconnects": _counter_sum(counters, ".reconnects") or 0,
             "retraces": int(counters.get("jax.retraces", 0)),
+            # Device-memory accounting (orion_tpu.devmem): live device
+            # buffer MB, falling back to the resident-history gauge when
+            # live_arrays introspection was unavailable on the worker.
+            "mem_mb": (
+                round(float(mem_bytes) / 1e6, 3) if mem_bytes is not None else None
+            ),
             "last_seen_s": round(now - float(doc.get("time") or now), 3),
+            # Age of the last metrics flush specifically (last_seen_s is
+            # min-merged with health below): the staleness signal.
+            "metrics_age_s": round(now - float(doc.get("time") or now), 3),
+            "health_age_s": None,
+            "stale": None,
             "health": None,
         }
 
@@ -161,7 +183,11 @@ def snapshot_top(experiment, now=None):
                 "gave_up": 0,
                 "reconnects": 0,
                 "retraces": 0,
+                "mem_mb": None,
                 "last_seen_s": None,
+                "metrics_age_s": None,
+                "health_age_s": None,
+                "stale": None,
                 "health": None,
             },
         )
@@ -192,6 +218,7 @@ def snapshot_top(experiment, now=None):
             )
             if latest.get(key) is not None
         }
+        entry["health_age_s"] = round(now - float(latest.get("time") or now), 3)
         entry["last_seen_s"] = round(
             now - float(latest.get("time") or now), 3
         )
@@ -199,6 +226,22 @@ def snapshot_top(experiment, now=None):
         window = max(times) - min(times)
         if len(docs) >= 2 and window > 0:
             entry["round_rate"] = round((len(docs) - 1) / window, 4)
+
+    # Staleness: the freshest of the two flush channels is the worker's
+    # liveness age; past 3× METRICS_FLUSH_INTERVAL the worker stopped
+    # flushing (crash, hang, partition) and its MAX-merged gauges are
+    # fossils — the marker says WHICH worker went quiet.
+    for entry in workers.values():
+        ages = [
+            a for a in (entry["metrics_age_s"], entry["health_age_s"])
+            if a is not None
+        ]
+        entry["flush_age_s"] = min(ages) if ages else None
+        entry["stale"] = (
+            entry["flush_age_s"] > STALE_AFTER
+            if entry["flush_age_s"] is not None
+            else None
+        )
 
     return {
         "experiment": experiment.name,
@@ -232,27 +275,41 @@ def render_top(snap):
         lines.append(f"objective  {sparkline(snap['regret_curve'])}")
     lines.append("")
     header = (
-        f"{'worker':<24} {'rounds':>6} {'rate/s':>7} {'hb lag':>7} "
-        f"{'sto p99':>8} {'retry':>5} {'reconn':>6} {'best_y':>12} "
-        f"{'gp_mll':>8} {'tr_len':>6}"
+        f"{'worker':<24} {'rounds':>6} {'rate/s':>7} {'age':>7} {'hb lag':>7} "
+        f"{'sto p99':>8} {'mem MB':>8} {'retry':>5} {'reconn':>6} "
+        f"{'best_y':>12} {'gp_mll':>8} {'tr_len':>6}"
     )
     lines.append(header)
     lines.append("-" * len(header))
+    stale_workers = []
     for worker, row in sorted(snap["workers"].items()):
         health = row.get("health") or {}
 
         def fmt(value, spec):
             return format(value, spec) if value is not None else "-"
 
+        # `!` marks a stale worker: no metrics/health flush for 3× the
+        # flush interval — its row is the last thing it said, not news.
+        age = row.get("flush_age_s")
+        age_cell = (fmt(age, "6.1f") + ("!" if row.get("stale") else " "))[:7]
+        if row.get("stale"):
+            stale_workers.append(worker)
         lines.append(
             f"{worker:<24} {row['rounds']:>6} "
             f"{fmt(row['round_rate'], '7.2f'):>7} "
+            f"{age_cell:>7} "
             f"{fmt(row['heartbeat_lag_s'], '6.1f'):>7} "
             f"{fmt(row['storage_p99_ms'], '7.1f'):>8} "
+            f"{fmt(row.get('mem_mb'), '8.1f'):>8} "
             f"{row['retries']:>5} {row['reconnects']:>6} "
             f"{fmt(health.get('best_y'), '12.5g'):>12} "
             f"{fmt(health.get('gp_mll'), '8.3f'):>8} "
             f"{fmt(health.get('tr_length'), '6.3f'):>6}"
+        )
+    if stale_workers:
+        lines.append(
+            f"STALE (no flush for > {STALE_AFTER:g}s): "
+            + ", ".join(stale_workers)
         )
     return "\n".join(lines)
 

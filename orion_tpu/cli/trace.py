@@ -8,6 +8,16 @@ this command merges every worker's spans into one Chrome trace-event JSON
 its own track, and the pipelined storage commit shows up as a
 ``storage.commit`` span running concurrently with the ``device.dispatch``
 window) or, with ``--format jsonl``, one span per line for ad-hoc tooling.
+
+``--distributed`` additionally joins the SERVER side of the experiment's
+traces (the netdb server flushes its adopted-context spans under the
+reserved ``__server__`` id; the merge matches them back by trace_id), so
+the exported file carries cross-process flow arrows — client commit →
+server apply, request → coalesced gateway dispatch.  ``--attribute``
+additionally prints the per-trace critical-path table: each sampled
+round's wall time bucketed into client-host / wire / server-host /
+device (orion_tpu.tracing) — ROADMAP item 2's burn-down as a
+measurement.
 """
 
 import json
@@ -33,17 +43,34 @@ def add_subparser(subparsers):
         help="chrome = trace-event JSON for Perfetto (default); "
         "jsonl = one span object per line",
     )
+    parser.add_argument(
+        "--distributed",
+        action="store_true",
+        help="merge server-side spans (netdb __server__ channel) into the "
+        "experiment's traces by trace_id — cross-process flow arrows",
+    )
+    parser.add_argument(
+        "--attribute",
+        action="store_true",
+        help="print the per-trace critical-path attribution table "
+        "(client-host / wire / server-host / device) in addition to "
+        "writing the trace file",
+    )
     parser.set_defaults(func=main)
     return parser
 
 
 def main(args):
     from orion_tpu.telemetry import write_chrome_trace
+    from orion_tpu.tracing import collect_distributed_spans, format_attribution
 
     experiment, _parser = build_from_args(
         args, need_user_args=False, allow_create=False, view=True
     )
-    spans = experiment.storage.fetch_spans(experiment)
+    if args.distributed or args.attribute:
+        spans = collect_distributed_spans(experiment.storage, experiment)
+    else:
+        spans = experiment.storage.fetch_spans(experiment)
     if not spans:
         print(
             f"no spans recorded for experiment {experiment.name!r} — run the "
@@ -62,4 +89,8 @@ def main(args):
         f"wrote {len(spans)} spans from {max(len(workers), 1)} worker(s) "
         f"to {args.out}"
     )
+    if args.attribute:
+        # Next to the file, never instead of it: a scripted pipeline that
+        # passed --out must still find its artifact.
+        print(format_attribution(spans))
     return 0

@@ -185,6 +185,18 @@ def main(args):
     env["ORION_TPU_TSAN"] = "1"
     env["ORION_TPU_TSAN_SEED"] = str(args.seed)
     env["ORION_TPU_TSAN_REPORT"] = handle.name
+    # The child must import THIS orion_tpu (the env hook lives in its
+    # __init__), but `python /path/to/script.py` puts the SCRIPT's dir at
+    # sys.path[0], not our cwd — from an uninstalled checkout the child
+    # would silently run uninstrumented (and write no report).  Prepend
+    # the package root to PYTHONPATH so the child resolves the same tree.
+    import orion_tpu
+
+    package_root = os.path.dirname(os.path.dirname(os.path.abspath(orion_tpu.__file__)))
+    existing = env.get("PYTHONPATH")
+    env["PYTHONPATH"] = (
+        package_root + os.pathsep + existing if existing else package_root
+    )
     if args.switch_rate is not None:
         env["ORION_TPU_TSAN_SWITCH"] = str(args.switch_rate)
     try:

@@ -91,6 +91,11 @@ DEFAULTS = {
     # process-wide registry as the ORION_TPU_TELEMETRY env var set it;
     # true/false here overrides (the CLI applies it in load_cli_config).
     "telemetry": None,
+    # Metrics export plane (orion_tpu.metrics): a port number starts this
+    # worker process's /metrics + /healthz daemon (Prometheus text
+    # exposition of the telemetry registry); None = no server.  The env
+    # spelling is ORION_TPU_METRICS_PORT.
+    "metrics_port": None,
     # Suggest gateway (orion_tpu.serve, docs/serving.md): a worker-level
     # knob, never part of the stored experiment identity.  None = local
     # algorithm instance (the default); {"address": "host:port", optional

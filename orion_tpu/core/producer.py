@@ -16,9 +16,10 @@ import time
 import numpy as np
 
 from orion_tpu.core.trial import RESERVABLE_STATUSES, Result, Trial
+from orion_tpu.devmem import sample_memory
 from orion_tpu.health import FLIGHT, flight_events_as_spans
 from orion_tpu.storage.retry import RetryPolicy
-from orion_tpu.telemetry import TELEMETRY
+from orion_tpu.telemetry import TELEMETRY, current_trace_context
 from orion_tpu.utils.exceptions import (
     AlgorithmExhausted,
     DuplicateKeyError,
@@ -111,6 +112,11 @@ class Producer:
         # commit visibly overlaps with in a trace.  None when telemetry is
         # disabled or nothing is in flight.
         self._spec_window_t0 = None
+        # TraceContext ambient at the speculative dispatch: the window span
+        # closes in a LATER round whose ambient belongs to that round — the
+        # saved context keeps the device window on the trace of the round
+        # that dispatched it.
+        self._spec_window_ctx = None
         # Trial ids already conditioned (register_suggestion + lie) onto the
         # CURRENT naive copy by _dispatch_speculative: the pipelined commit
         # may re-invoke it on the same instance (mid-loop dispatch opted
@@ -243,7 +249,9 @@ class Producer:
         self._pending_timings.append((op, duration, count))
         # Guarded: the span name f-string and args dict must not be
         # allocated per sample when telemetry is off — this runs inside
-        # every produce()/update() round.
+        # every produce()/update() round.  The ambient TraceContext is
+        # captured NOW (fifth element): the batch flushes at round end,
+        # when the ambient may already belong to the next round.
         if TELEMETRY.enabled:
             self._pending_spans.append(
                 (
@@ -251,6 +259,7 @@ class Producer:
                     time.perf_counter() - duration,
                     duration,
                     {"count": count},
+                    current_trace_context(),
                 )
             )
 
@@ -294,6 +303,10 @@ class Producer:
                     force_metrics
                     or now - self._last_metrics_flush >= self.METRICS_FLUSH_INTERVAL
                 ):
+                    # Device-memory/compile-cache gauges ride the same
+                    # low-frequency gate (rate-limited again inside), so a
+                    # snapshot never ships stale memory numbers.
+                    sample_memory(force=force_metrics)
                     self.experiment.storage.record_metrics(
                         self.experiment, TELEMETRY.snapshot()
                     )
@@ -365,7 +378,11 @@ class Producer:
         caller against itself (``ExperimentClient.suggest`` holding a
         partial batch) — so the wait only applies when reserved trials
         beyond the caller's own exist."""
-        with TELEMETRY.span("producer.round"):
+        # root=True: every produce round IS one distributed trace — the
+        # storage commits, wire hops and server-side applies it causes all
+        # stamp this round's trace_id, which is what `orion-tpu trace
+        # --attribute` buckets the round's wall time by.
+        with TELEMETRY.span("producer.round", root=True):
             return self._produce(pool_size, own_in_flight)
 
     def _produce(self, pool_size, own_in_flight):
@@ -559,13 +576,15 @@ class Producer:
         """Close the open ``device.dispatch`` span (if any): the async device
         work window from speculative dispatch to finalize/discard."""
         t0, self._spec_window_t0 = self._spec_window_t0, None
+        ctx, self._spec_window_ctx = self._spec_window_ctx, None
         # t0 is only ever stamped with telemetry enabled, but the args dict
         # below must provably not allocate on the disabled path, so the
         # guard is explicit (it also closes the window cleanly if the
         # registry was disabled mid-run).
         if t0 is not None and TELEMETRY.enabled:
             TELEMETRY.record_span(
-                "device.dispatch", start=t0, args={"outcome": outcome}
+                "device.dispatch", start=t0, args={"outcome": outcome},
+                parent_ctx=ctx,
             )
 
     def _dispatch_speculative(self, pool_size, registered_trials):
@@ -645,6 +664,8 @@ class Producer:
         self.algorithm.rng_key = algo.rng_key
         self._speculative = (handle, algo)
         self._spec_window_t0 = t_dispatch
+        if t_dispatch is not None:
+            self._spec_window_ctx = current_trace_context()
         return True
 
     def _take_speculative(self, pool_size):

@@ -77,6 +77,14 @@ def workon(
     """Run the optimization loop for up to `worker_trials` trials."""
     if worker_trials is None or worker_trials < 0:
         worker_trials = float("inf")
+    # Pull-based metrics plane (orion_tpu.metrics): a worker opts in via
+    # the ORION_TPU_METRICS_PORT env var (or the `metrics_port:` config
+    # key, which cli/base.py resolves to the same call) — idempotent, one
+    # daemon /metrics + /healthz server per process, failures logged not
+    # raised.
+    from orion_tpu.metrics import ensure_worker_metrics_server
+
+    ensure_worker_metrics_server()
     producer = Producer(experiment, max_idle_time=max_idle_time)
     consumer = Consumer(
         experiment, cmdline_parser, heartbeat_interval=heartbeat_interval

@@ -1,0 +1,188 @@
+"""Device-memory & compile-cache accounting: low-frequency gauge sampler.
+
+Publishes, into the process-wide telemetry registry (so the numbers flow
+through the storage metrics channel into ``orion-tpu info``/``top`` and
+out of ``/metrics`` like every other gauge):
+
+- ``memory.device_live_bytes`` / ``memory.device_live_arrays`` — the sum
+  over ``jax.live_arrays()`` (every device buffer the process still
+  references) and their count;
+- ``memory.device_bytes_in_use`` / ``memory.device_peak_bytes`` — the
+  backend allocator's own accounting via ``Device.memory_stats()``, with
+  graceful degradation: backends without the API (or returning None —
+  older CPU backends) simply publish nothing;
+- ``memory.history_device_bytes.b<cap>`` — resident observation-history
+  bytes per pow-2 capacity bucket (``DeviceHistory`` introspection: the
+  distribution says which experiments are about to cross a bucket);
+  ``memory.history_device_bytes`` the total, ``memory.history_host_bytes``
+  the :class:`HostHistory` mirror total, ``memory.history_count`` live
+  instances;
+- ``memory.fused_cache_entries`` — the fused suggest step's jit-cache
+  entry count (the private ``_cache_size`` accessor; None-safe), plus
+  ``memory.stacked_cache_entries`` for the gateway's stacked step;
+- ``memory.prewarm_started`` / ``memory.prewarm_completed`` — the prewarm
+  inventory (signatures launched / compiles finished, process-wide).
+
+Donation-hit accounting is the histories' own job (``history.appends.
+donated`` / ``.copied`` counters booked at append time); the sampler only
+reads state that already exists — TEL-discipline clean: one enabled-flag
+check and one monotonic read on the cold path, every allocating call
+behind them, and the rate-limit cell is tsan-annotated shared state.
+
+Callers: the producer's metrics-flush gate and every ``/metrics`` scrape
+(forced — the scrape IS the frequency source there, and it runs on the
+HTTP handler thread so the gateway's dispatcher never pays the
+live-buffer walk).
+"""
+
+import logging
+import os
+import threading
+import time
+
+from orion_tpu.analysis.sanitizer import TSAN
+from orion_tpu.telemetry import TELEMETRY
+
+log = logging.getLogger(__name__)
+
+#: Seconds between samples (env-tunable): ``jax.live_arrays()`` walks every
+#: live buffer, which must stay off the per-round hot path.
+try:
+    SAMPLE_INTERVAL = float(
+        os.environ.get("ORION_TPU_MEMORY_INTERVAL", "") or 10.0
+    )
+except ValueError:  # pragma: no cover - hostile env
+    SAMPLE_INTERVAL = 10.0
+
+_lock = threading.Lock()
+_last_sample = 0.0
+
+#: Pow-2 capacity -> gauge name, built lazily so the per-bucket set_gauge
+#: call sites pass a plain NAME (no per-call key computation — TEL001).
+_BUCKET_GAUGE_NAMES = {}
+
+
+def _bucket_gauge_name(cap):
+    name = _BUCKET_GAUGE_NAMES.get(cap)
+    if name is None:
+        name = f"memory.history_device_bytes.b{int(cap)}"
+        _BUCKET_GAUGE_NAMES[cap] = name
+    return name
+
+
+def sample_memory(force=False):
+    """Publish the memory/compile gauges; rate-limited to
+    :data:`SAMPLE_INTERVAL` unless ``force``.  Returns True when a sample
+    ran.  Never raises — accounting must not break a run."""
+    if not TELEMETRY.enabled:
+        return False
+    global _last_sample
+    now = time.monotonic()
+    with _lock:
+        TSAN.write("devmem._state")
+        if not force and now - _last_sample < SAMPLE_INTERVAL:
+            return False
+        _last_sample = now
+    try:
+        _sample_live_arrays()
+        _sample_backend_stats()
+        _sample_histories()
+        _sample_compile_caches()
+        _sample_prewarm_inventory()
+    except Exception:  # pragma: no cover - observability never breaks a run
+        log.debug("memory sample failed", exc_info=True)
+    return True
+
+
+def _sample_live_arrays():
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+    except Exception:  # backend without the API
+        return
+    total = 0
+    count = 0
+    for array in arrays:
+        count += 1
+        try:
+            total += int(array.nbytes)
+        except Exception:  # pragma: no cover - deleted buffer mid-walk
+            pass
+    TELEMETRY.set_gauge("memory.device_live_bytes", total)
+    TELEMETRY.set_gauge("memory.device_live_arrays", count)
+
+
+def _sample_backend_stats():
+    """Allocator-level accounting — graceful degradation when the backend
+    lacks ``memory_stats`` (or answers None, as CPU backends may)."""
+    try:
+        import jax
+
+        device = jax.local_devices()[0]
+        stats_fn = getattr(device, "memory_stats", None)
+        stats = stats_fn() if stats_fn is not None else None
+    except Exception:
+        return
+    if not isinstance(stats, dict):
+        return
+    in_use = stats.get("bytes_in_use")
+    if in_use is not None:
+        TELEMETRY.set_gauge("memory.device_bytes_in_use", in_use)
+    peak = stats.get("peak_bytes_in_use")
+    if peak is not None:
+        TELEMETRY.set_gauge("memory.device_peak_bytes", peak)
+
+
+def _sample_histories():
+    from orion_tpu.algo.history import history_memory_stats
+
+    stats = history_memory_stats()
+    TELEMETRY.set_gauge("memory.history_device_bytes", stats["device_bytes"])
+    TELEMETRY.set_gauge("memory.history_host_bytes", stats["host_bytes"])
+    TELEMETRY.set_gauge("memory.history_count", stats["device_count"])
+    buckets = stats["device_buckets"]
+    # Gauges are last-write-wins and never deleted: a bucket every history
+    # has grown out of must be ZEROED, or its stale byte count survives
+    # forever and the per-bucket sum stops matching the total.
+    for cap in _BUCKET_GAUGE_NAMES:
+        if cap not in buckets:
+            name = _bucket_gauge_name(cap)
+            TELEMETRY.set_gauge(name, 0)
+    for cap, nbytes in buckets.items():
+        name = _bucket_gauge_name(cap)
+        TELEMETRY.set_gauge(name, nbytes)
+
+
+def _sample_compile_caches():
+    """Fused-step jit-cache entry counts via the private ``_cache_size``
+    accessor product code already degrades around (prewarm detection) —
+    absent accessor publishes nothing, not zero."""
+    try:
+        from orion_tpu.algo.tpu_bo import _suggest_step
+
+        cache_size = getattr(_suggest_step, "_cache_size", None)
+        if cache_size is not None:
+            TELEMETRY.set_gauge("memory.fused_cache_entries", cache_size())
+    except Exception:  # pragma: no cover - import/introspection drift
+        pass
+    try:
+        import sys
+
+        coalesce = sys.modules.get("orion_tpu.serve.coalesce")
+        if coalesce is not None:  # only if the serve stack is actually loaded
+            cache_size = getattr(
+                coalesce._stacked_suggest_step, "_cache_size", None
+            )
+            if cache_size is not None:
+                TELEMETRY.set_gauge("memory.stacked_cache_entries", cache_size())
+    except Exception:  # pragma: no cover - introspection drift
+        pass
+
+
+def _sample_prewarm_inventory():
+    from orion_tpu.algo.prewarm import prewarm_inventory
+
+    inventory = prewarm_inventory()
+    TELEMETRY.set_gauge("memory.prewarm_started", inventory["started"])
+    TELEMETRY.set_gauge("memory.prewarm_completed", inventory["completed"])

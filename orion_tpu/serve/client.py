@@ -57,6 +57,17 @@ from orion_tpu.utils.exceptions import DatabaseError
 
 log = logging.getLogger(__name__)
 
+#: Per-op client span names, precomputed so the request hot path never
+#: builds a metric key — these are the CLIENT half of a distributed trace
+#: hop: the gateway adopts the injected context as the parent of its
+#: ``serve.request`` span, so wire time = client span − gateway span.
+_CLIENT_SPAN_NAMES = {
+    "suggest": "serve.client.suggest",
+    "observe": "serve.client.observe",
+    "register": "serve.client.register",
+    "attach": "serve.client.attach",
+}
+
 #: Replay-log bound (observe/register batches, not rows).  Far beyond any
 #: normal run's round count; hitting it degrades the GATEWAY-LOSS recovery
 #: to the most recent batches (with a warning) — normal operation, worker
@@ -218,14 +229,32 @@ class GatewayClient:
         """One gateway op under the retry policy.  ``mode`` declares the
         applied-or-not contract exactly as the storage layer's decorators
         do; every current op is ``"always"`` because each carries a
-        client-minted id the gateway dedups on (see module docstring)."""
+        client-minted id the gateway dedups on (see module docstring).
+
+        Each attempt (including re-asks) runs as its own ``serve.client.*``
+        span and injects that span's :class:`TraceContext` as the request's
+        optional ``ctx`` field — the gateway adopts it, so the distributed
+        merge draws client request -> gateway -> coalesced dispatch.
+        Pre-upgrade gateways ignore the key."""
         body = dict(payload or {})
         body["op"] = op
-        line = dumps_line(body)
+        span_name = _CLIENT_SPAN_NAMES.get(op, "serve.client.request")
 
         def call():
-            with self._lock:
-                response = self._exchange_once(op, line)
+            if TELEMETRY.enabled:
+                with TELEMETRY.span(span_name) as span:
+                    # span.ctx is None when no ambient trace exists (a bare
+                    # client outside a producer round): nothing to inject.
+                    ctx = span.ctx
+                    if ctx is not None and ctx.sampled:
+                        body["ctx"] = ctx.to_wire()
+                    line = dumps_line(body)
+                    with self._lock:
+                        response = self._exchange_once(op, line)
+            else:
+                line = dumps_line(body)
+                with self._lock:
+                    response = self._exchange_once(op, line)
             return self._translate(op, response)
 
         if self._policy is None:

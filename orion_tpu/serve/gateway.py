@@ -40,6 +40,7 @@ import logging
 import os
 import pickle
 import queue
+import socket
 import socketserver
 import threading
 import time
@@ -64,7 +65,7 @@ from orion_tpu.serve.protocol import (
 )
 from orion_tpu.space.dsl import build_space
 from orion_tpu.storage.backends import atomic_pickle_dump
-from orion_tpu.telemetry import TELEMETRY
+from orion_tpu.telemetry import TELEMETRY, TraceContext
 
 log = logging.getLogger(__name__)
 
@@ -146,7 +147,7 @@ class _WorkItem:
     on ``done`` in between."""
 
     __slots__ = ("op", "tenant_name", "payload", "reply", "done", "counted",
-                 "enqueued_at")
+                 "enqueued_at", "ctx")
 
     def __init__(self, op, payload):
         self.op = op
@@ -156,6 +157,11 @@ class _WorkItem:
         self.done = threading.Event()
         self.counted = False  # holds an inflight-quota slot
         self.enqueued_at = time.perf_counter()
+        # Distributed-trace adoption: the client's injected context (only
+        # present when the CLIENT ran with telemetry on) parents this
+        # request's gateway-side spans and is what the coalesced dispatch
+        # span links back to.  Absent/malformed -> None, zero cost.
+        self.ctx = TraceContext.from_wire(payload.get("ctx"))
 
 
 #: Sentinel reply meaning "hang up instead of answering": a stopping
@@ -213,6 +219,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         request_timeout=120.0,
         persist=None,
         persist_interval=5.0,
+        metrics_port=None,
     ):
         self.window = float(window)
         self.max_width = max(1, int(max_width))
@@ -241,13 +248,50 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             "max_width": 0,
             "widths": {},
         }
+        # Track label for this gateway's own spans: a distinct Perfetto
+        # track even when the gateway runs in-process with its clients.
+        self._span_track = f"gateway:{socket.gethostname()}:{os.getpid()}"
         if persist and os.path.exists(persist):
             self._restore(persist)
         super().__init__((host, int(port)), _Handler)
+        # Optional pull-based metrics plane: /metrics (Prometheus text
+        # exposition of the process registry) + /healthz (queue depth,
+        # tenant count) on a stdlib http.server daemon thread.  A bind
+        # failure fails the CONSTRUCTOR (the operator explicitly asked for
+        # a scrape endpoint; a gateway silently missing its monitoring is
+        # worse than one that won't start) — but never leaks the already-
+        # bound gateway socket.
+        self._metrics_server = None
+        if metrics_port is not None:
+            from orion_tpu.metrics import MetricsServer
+
+            try:
+                self._metrics_server = MetricsServer(
+                    port=int(metrics_port), healthz=self._healthz_snapshot
+                )
+            except OSError:
+                self.server_close()
+                raise
+            self._metrics_server.start()
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="orion-tpu-gateway", daemon=True
         )
         self._dispatcher.start()
+
+    def _healthz_snapshot(self):
+        """The /healthz payload: liveness plus the two saturation signals
+        an external prober needs (bounded queue depth, hosted tenants).
+        Runs on the metrics server's handler threads — the tenant-table
+        read rides the gateway lock like every other cross-thread read."""
+        with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
+            tenants = len(self._tenants)
+        return {
+            "ok": True,
+            "queue_depth": self._queue.qsize(),
+            "tenants": tenants,
+            "stopping": self._stop.is_set(),
+        }
 
     # --- lifecycle -----------------------------------------------------------
     @property
@@ -264,6 +308,8 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         self._stop.set()
         super().shutdown()
         self._dispatcher.join(timeout=5.0)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
         # Final durable snapshot — same exit discipline as DBServer.
         if self.persist and self._dirty:
             self._write_snapshot()
@@ -470,6 +516,19 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             item.counted = False
         item.reply = reply
         item.done.set()
+        if TELEMETRY.enabled and item.ctx is not None:
+            # The gateway-side half of the request's distributed trace:
+            # queue wait + execution, parented at the client's injected
+            # context, on this gateway's own track.  histogram=False — the
+            # observe() below is the sample's one histogram home.
+            TELEMETRY.record_span(
+                "serve.request",
+                start=item.enqueued_at,
+                args={"op": item.op},
+                parent_ctx=item.ctx,
+                track=self._span_track,
+                histogram=False,
+            )
         TELEMETRY.observe(
             "serve.request", time.perf_counter() - item.enqueued_at
         )
@@ -757,6 +816,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
     def _dispatch_chunk(self, chunk):
         """One coalesced (or singleton) fused dispatch + demux."""
         width = len(chunk)
+        t0 = time.perf_counter() if TELEMETRY.enabled else None
         try:
             if width == 1:
                 job = chunk[0]
@@ -778,6 +838,19 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                     job.item, error_reply(type(exc).__name__, str(exc))
                 )
             return
+        if t0 is not None:
+            # The shared stacked-step dispatch belongs to EVERY coalesced
+            # tenant's trace at once — it records LINKS to each request's
+            # context instead of a single parent, and the trace exporter
+            # draws one flow arrow per link.
+            links = [job.item.ctx for job in chunk if job.item.ctx is not None]
+            TELEMETRY.record_span(
+                "serve.dispatch",
+                start=t0,
+                args={"width": width},
+                links=links or None,
+                track=self._span_track,
+            )
         self._book_dispatch(width)
         self._maybe_prewarm_width(chunk[0], width)
         for job, (rows, state) in zip(chunk, results):

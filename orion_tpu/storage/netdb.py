@@ -39,7 +39,13 @@ import time
 from orion_tpu.health import FLIGHT
 from orion_tpu.storage.backends import atomic_pickle_dump
 from orion_tpu.storage.documents import MemoryDB
-from orion_tpu.telemetry import TELEMETRY
+from orion_tpu.telemetry import (
+    TELEMETRY,
+    Telemetry,
+    TraceContext,
+    current_trace_context,
+)
+from orion_tpu.tracing import SERVER_EXPERIMENT
 from orion_tpu.analysis.sanitizer import TSAN
 from orion_tpu.utils.exceptions import (
     AuthenticationError,
@@ -234,6 +240,12 @@ class _Handler(socketserver.StreamRequestHandler):
             }
         if op == "batch":
             return self._batch_dispatch(db, request)
+        # Distributed tracing: a request may carry an optional `ctx` field
+        # (the client's ambient TraceContext) — adopted as the parent of
+        # this server's apply span.  Pre-upgrade clients simply omit it;
+        # pre-upgrade servers ignored unknown top-level keys, so the field
+        # is wire-compatible in both directions.
+        t0, ctx = self.server.adopt_begin(request)
         try:
             method = getattr(db, op)
             result = method(*request.get("args", []), **request.get("kwargs", {}))
@@ -244,6 +256,8 @@ class _Handler(socketserver.StreamRequestHandler):
             if not isinstance(exc, (DuplicateKeyError, KeyError)):
                 log.exception("op %s failed", op)  # pragma: no cover - defensive
             return _encode_outcome(exc)
+        finally:
+            self.server.adopt_finish(op, t0, ctx)
 
     def _batch_dispatch(self, db, request):
         """ONE request carrying N sub-operations: applied as one atomic
@@ -280,6 +294,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 "error": "DatabaseError",
                 "message": f"malformed batch request: {exc}",
             }
+        t0, ctx = self.server.adopt_begin(request)
         try:
             apply_batch = getattr(db, "apply_batch", None)
             if apply_batch is not None:
@@ -300,6 +315,10 @@ class _Handler(socketserver.StreamRequestHandler):
             # maybe_applied survive the wire.
             log.exception("batch of %d ops failed", len(normalized))
             return _encode_outcome(exc)
+        finally:
+            # In a finally like the single-op path: a FAILED batch is the
+            # one whose server-side span the post-mortem needs most.
+            self.server.adopt_finish("batch", t0, ctx)
 
 
 class DBServer(socketserver.ThreadingTCPServer):
@@ -309,6 +328,14 @@ class DBServer(socketserver.ThreadingTCPServer):
 
     allow_reuse_address = True
     daemon_threads = True
+
+    #: Seconds between flushes of the server's OWN adopted-ctx spans into
+    #: its spans collection (under the reserved ``__server__`` experiment
+    #: id) — what `orion-tpu trace --distributed` joins back by trace_id.
+    SPAN_FLUSH_INTERVAL = 1.0
+    #: Retention cap for the __server__ span channel (same unbounded-growth
+    #: guard as DocumentStorage.SPANS_CAP; pruned with hysteresis to 90%).
+    SERVER_SPANS_CAP = 20000
 
     def __init__(
         self,
@@ -320,6 +347,16 @@ class DBServer(socketserver.ThreadingTCPServer):
     ):
         self.persist = persist
         self.persist_interval = persist_interval
+        # Server-side span recording rides a PRIVATE registry, not the
+        # process-global one: an in-process loopback server sharing the
+        # global ring would have its spans drained (exactly-once) by
+        # whichever worker flush ran next, splitting them unpredictably
+        # between the experiment channel and the __server__ channel.
+        # Mutations are gated on the GLOBAL TELEMETRY.enabled switch.
+        self._span_tel = Telemetry(enabled=True, span_capacity=2048)
+        self._span_flush_lock = threading.Lock()
+        self._last_span_flush = 0.0
+        self._span_track = f"netdb:{socket.gethostname()}:{os.getpid()}"
         # Shared-secret authentication (reference parity: the networked
         # backend takes username/password credentials,
         # `mongodb.py:86,289`).  None = open server for localhost dev.
@@ -353,6 +390,75 @@ class DBServer(socketserver.ThreadingTCPServer):
     def address(self):
         return self.server_address[:2]
 
+    # --- distributed-trace adoption ------------------------------------------
+    def adopt_begin(self, request):
+        """``(t0, ctx)`` when this request carries a sampled trace context
+        and telemetry is on — the handler's apply span window opens here;
+        ``(None, None)`` otherwise (zero-cost beyond one dict get)."""
+        if not TELEMETRY.enabled:
+            return None, None
+        wire = request.get("ctx")
+        if wire is None:
+            return None, None
+        ctx = TraceContext.from_wire(wire)
+        if ctx is None or not ctx.sampled:
+            return None, None
+        return time.perf_counter(), ctx
+
+    def adopt_finish(self, op, t0, ctx):
+        """Record the server-side ``netdb.apply`` span parented at the
+        client's injected context, on this server's own trace track."""
+        if t0 is None:
+            return
+        self._span_tel.record_span(
+            "netdb.apply",
+            start=t0,
+            args={"op": op},
+            parent_ctx=ctx,
+            track=self._span_track,
+        )
+        self.flush_server_spans()
+
+    def flush_server_spans(self, force=False):
+        """Drain the private span ring into this server's own ``spans``
+        collection under :data:`~orion_tpu.tracing.SERVER_EXPERIMENT`
+        (rate-limited; the server has no experiment identity, so the merge
+        joins these back by trace_id).  Never raises — observability must
+        not break the wire."""
+        now = time.monotonic()
+        with self._span_flush_lock:
+            TSAN.write("DBServer._span_flush", self)
+            if not force and now - self._last_span_flush < self.SPAN_FLUSH_INTERVAL:
+                return
+            self._last_span_flush = now
+        spans = self._span_tel.drain_spans()
+        if not spans:
+            return
+        try:
+            self.db.write(
+                "spans",
+                [
+                    {"experiment": SERVER_EXPERIMENT, "worker": self._span_track, **s}
+                    for s in spans
+                ],
+            )
+            # Bounded retention (runs at most once per flush gate): prune
+            # the oldest down to 90% of the cap, same hysteresis rationale
+            # as DocumentStorage._prune_spans.
+            query = {"experiment": SERVER_EXPERIMENT}
+            if self.db.count("spans", query) > self.SERVER_SPANS_CAP:
+                docs = self.db.read("spans", query)
+                keep = max(1, int(self.SERVER_SPANS_CAP * 0.9))
+                if len(docs) > keep:
+                    docs.sort(key=lambda d: d.get("ts") or 0.0)
+                    cutoff = docs[len(docs) - keep].get("ts") or 0.0
+                    self.db.remove(
+                        "spans",
+                        {"experiment": SERVER_EXPERIMENT, "ts": {"$lt": cutoff}},
+                    )
+        except Exception:  # pragma: no cover - observability never breaks serving
+            log.debug("could not flush server spans", exc_info=True)
+
     def persist_snapshot(self):
         """Mark the DB dirty; the flusher thread writes at most one snapshot
         per ``persist_interval`` — a per-mutation dump would hold the DB lock
@@ -367,6 +473,7 @@ class DBServer(socketserver.ThreadingTCPServer):
         if not (self._snapshotting and self._dirty.is_set()):
             return
         self._dirty.clear()
+        t0 = time.perf_counter() if TELEMETRY.enabled else None
         with self._persist_lock:
             # Hold the DB lock while pickling: handler threads mutate the
             # collections concurrently and pickle iterating a changing dict
@@ -379,10 +486,22 @@ class DBServer(socketserver.ThreadingTCPServer):
             # lint: disable=LCK003 -- one-directional flusher edge; persist_lock always outer
             with self.db._lock:
                 atomic_pickle_dump(self.persist, self.db)
+        if t0 is not None:
+            # The persist span rides the server track (no parent: the
+            # flusher batches many requests' dirt into one dump).  Recorded
+            # OUTSIDE the persist lock — span bookkeeping must never mint a
+            # persist_lock -> registry-lock ordering edge.
+            self._span_tel.record_span(
+                "netdb.persist", start=t0, track=self._span_track
+            )
 
     def shutdown(self):
         self._stop_flusher.set()
         super().shutdown()
+        # Span flush BEFORE the final snapshot so adopted spans recorded
+        # since the last gate land in the persisted image too.
+        if TELEMETRY.enabled:
+            self.flush_server_spans(force=True)
         self._flush_if_dirty()  # final durable snapshot
 
     def serve_background(self):
@@ -607,8 +726,22 @@ class NetworkDB:
         except (OSError, ConnectionError, json.JSONDecodeError):
             self._close()  # mutation path will reconnect fresh
 
+    @staticmethod
+    def _wire_request(op, args, kwargs):
+        """The request envelope, with the ambient TraceContext injected as
+        the optional ``ctx`` field when telemetry is on — the server adopts
+        it as the parent of its apply span.  Pre-upgrade servers ignore the
+        key (wire-compatible), and a disabled registry pays one attribute
+        check."""
+        request = {"op": op, "args": list(args), "kwargs": kwargs}
+        if TELEMETRY.enabled:
+            ctx = current_trace_context()
+            if ctx is not None and ctx.sampled:
+                request["ctx"] = ctx.to_wire()
+        return request
+
     def _call(self, op, *args, **kwargs):
-        payload = _dumps({"op": op, "args": list(args), "kwargs": kwargs})
+        payload = _dumps(self._wire_request(op, args, kwargs))
         retriable = op in self._IDEMPOTENT
         with self._lock:
             for attempt in range(2):
@@ -657,7 +790,7 @@ class NetworkDB:
         if not ops:
             return []
         payload = b"".join(
-            _dumps({"op": op, "args": list(args), "kwargs": kwargs})
+            _dumps(self._wire_request(op, args, kwargs))
             for op, args, kwargs in ops
         )
         with self._lock:
@@ -766,10 +899,11 @@ class NetworkDB:
         ):
             return self.pipeline(ops)
         payload = _dumps(
-            {
-                "op": "batch",
-                "args": [[[op, list(args), kwargs] for op, args, kwargs in ops]],
-            }
+            self._wire_request(
+                "batch",
+                [[[op, list(args), kwargs] for op, args, kwargs in ops]],
+                {},
+            )
         )
         if len(payload) > _MAX_LINE:
             # One line over the server's readline cap would be read as a

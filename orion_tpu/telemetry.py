@@ -29,6 +29,20 @@ round; ``orion-tpu info`` merges the snapshots with
 :func:`merge_snapshots`, and ``orion-tpu trace`` merges every worker's
 spans into one Chrome trace (span timestamps are wall-anchored monotonic
 readings, so processes line up on a shared timeline).
+
+Distributed tracing: a :class:`TraceContext` (128-bit ``trace_id``, 64-bit
+``span_id``, ``sampled`` flag) rides a thread-local ambient slot.  With
+telemetry enabled, a ``with``-managed span minted under an ambient context
+becomes a CHILD of it (fresh ``span_id``, same ``trace_id``) and installs
+itself as the ambient for its body, so nesting builds a real tree; span
+records carry ``trace_id``/``span_id``/``parent_span_id``.  The wire
+drivers (``storage/netdb.py``, ``serve/client.py``) inject the ambient
+context as an optional ``ctx`` field in their request envelopes and the
+servers adopt it as the parent of their own spans — pre-upgrade peers
+simply ignore the extra key, so the field is wire-compatible in both
+directions.  :func:`chrome_trace_events` turns the cross-process
+parent/link edges into Perfetto flow events (``s``/``f`` phases), so the
+merged trace draws arrows across process tracks.
 """
 
 import json
@@ -53,6 +67,89 @@ N_BUCKETS = 48
 DEFAULT_SPAN_CAPACITY = 4096
 
 
+# --- distributed trace context ----------------------------------------------
+class TraceContext:
+    """One hop of a distributed trace: ``trace_id`` names the end-to-end
+    request (128-bit hex), ``span_id`` the CURRENT span within it (64-bit
+    hex), ``sampled`` whether downstream hops should record at all.
+
+    Immutable by convention: crossing into a new span mints a :meth:`child`
+    (same trace, fresh span id) rather than mutating in place, so a context
+    captured into a wire payload or a buffered span entry stays valid."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id=None, span_id=None, sampled=True):
+        self.trace_id = trace_id or os.urandom(16).hex()
+        self.span_id = span_id or os.urandom(8).hex()
+        self.sampled = bool(sampled)
+
+    def child(self):
+        """Same trace, fresh span id — the context a nested span runs as."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(), self.sampled)
+
+    def to_wire(self):
+        """The optional ``ctx`` field of a wire envelope.  Peers that
+        predate distributed tracing ignore unknown top-level keys, so
+        injecting this is compatible in both directions."""
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "sampled": self.sampled,
+        }
+
+    @staticmethod
+    def from_wire(payload):
+        """Adopt a wire ``ctx`` field; tolerant — anything malformed (or
+        absent) yields None so a hostile/buggy peer can never break the
+        server's dispatch path."""
+        if not isinstance(payload, dict):
+            return None
+        trace_id = payload.get("trace_id")
+        span_id = payload.get("span_id")
+        if not isinstance(trace_id, str) or not isinstance(span_id, str):
+            return None
+        return TraceContext(trace_id, span_id, bool(payload.get("sampled", True)))
+
+
+_AMBIENT = threading.local()
+
+
+def current_trace_context():
+    """This thread's ambient :class:`TraceContext`, or None."""
+    return getattr(_AMBIENT, "ctx", None)
+
+
+def set_trace_context(ctx):
+    """Install ``ctx`` (or None) as the ambient context; returns the
+    previous one so callers can restore it."""
+    prev = getattr(_AMBIENT, "ctx", None)
+    _AMBIENT.ctx = ctx
+    return prev
+
+
+class trace_scope:
+    """``with trace_scope(ctx):`` — adopt an explicit context (e.g. one
+    decoded off the wire) for a block, restoring the previous ambient on
+    exit.  ``ctx=None`` is a no-op scope."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._prev = None
+
+    def __enter__(self):
+        if self._ctx is not None:
+            self._prev = set_trace_context(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._ctx is not None:
+            set_trace_context(self._prev)
+        return False
+
+
 def _bucket_of(seconds):
     """Index of the log2-µs bucket holding ``seconds``."""
     micros = int(seconds * 1e6)
@@ -72,6 +169,11 @@ class _NullSpan:
 
     __slots__ = ()
 
+    #: Same surface as _Span: a caller that checked ``enabled`` and then
+    #: raced a concurrent disable() gets this singleton from span() — its
+    #: ``.ctx`` read must degrade to "untraced", never AttributeError.
+    ctx = None
+
     def __enter__(self):
         return self
 
@@ -83,22 +185,55 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    """An enabled span: records itself into the registry on exit."""
+    """An enabled span: records itself into the registry on exit.
 
-    __slots__ = ("_telemetry", "name", "args", "_t0")
+    Trace threading: a ``root=True`` span mints a FRESH :class:`TraceContext`
+    (a new distributed trace — the producer round); otherwise, when an
+    ambient sampled context exists, the span runs as its child and installs
+    itself as the ambient for the body, so nested spans (and wire
+    injections inside the body) parent here."""
 
-    def __init__(self, telemetry, name, args):
+    __slots__ = ("_telemetry", "name", "args", "_t0", "_root", "_ctx", "_prev")
+
+    def __init__(self, telemetry, name, args, root=False):
         self._telemetry = telemetry
         self.name = name
         self.args = args
+        self._root = root
         self._t0 = None
+        self._ctx = None
+        self._prev = None
+
+    @property
+    def ctx(self):
+        """This span's own :class:`TraceContext` (None when untraced)."""
+        return self._ctx
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        prev = current_trace_context()
+        if self._root:
+            self._ctx = TraceContext()
+        elif prev is not None and prev.sampled:
+            self._ctx = prev.child()
+        if self._ctx is not None:
+            self._prev = set_trace_context(self._ctx)
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        self._telemetry.record_span(self.name, start=self._t0, args=self.args)
+        if self._ctx is not None:
+            set_trace_context(self._prev)
+        self._telemetry.record_span(
+            self.name,
+            start=self._t0,
+            args=self.args,
+            span_ctx=self._ctx,
+            # A root span STARTS its trace: the enclosing ambient (an
+            # embedder's unrelated trace) must not become its parent, or
+            # the record's parent_span_id points into a foreign trace and
+            # attribution finds no root.
+            parent_ctx=None if self._root else self._prev,
+        )
         return False
 
 
@@ -236,15 +371,29 @@ class Telemetry:
         return out
 
     # --- spans --------------------------------------------------------------
-    def span(self, name, args=None):
+    def span(self, name, args=None, root=False):
         """Context manager timing a block.  Disabled: the shared no-op
         singleton (no allocation, no clock read).  Enabled: records a span
-        AND a duration sample into the histogram of the same name."""
+        AND a duration sample into the histogram of the same name.
+        ``root=True`` starts a NEW distributed trace for the body (the
+        producer-round entry point); otherwise the span becomes a child of
+        any ambient :class:`TraceContext`."""
         if not self.enabled:
             return _NULL_SPAN
-        return _Span(self, name, args)
+        return _Span(self, name, args, root=root)
 
-    def record_span(self, name, start=None, duration=None, args=None, histogram=True):
+    def record_span(
+        self,
+        name,
+        start=None,
+        duration=None,
+        args=None,
+        histogram=True,
+        span_ctx=None,
+        parent_ctx=None,
+        links=None,
+        track=None,
+    ):
         """Record one finished span explicitly.
 
         ``start``/``duration`` are ``time.perf_counter()`` readings/deltas;
@@ -254,12 +403,31 @@ class Telemetry:
         span and its histogram sample come from the same clock reading.
         ``histogram=False`` records the span only — for call sites that
         feed a differently-keyed histogram themselves (the storage layer's
-        per-backend op histograms) and must not double-book the sample."""
+        per-backend op histograms) and must not double-book the sample.
+
+        Trace stamping: ``span_ctx`` is this span's OWN identity (its
+        ``span_id``), ``parent_ctx`` its parent; pass only ``parent_ctx``
+        (the adopting-server case — a context decoded off the wire) and a
+        fresh ``span_id`` is minted.  With neither, the thread's ambient
+        context (if sampled) parents the record.  ``links`` is a list of
+        contexts/{trace_id, span_id} dicts joined non-hierarchically (the
+        gateway's coalesced dispatch links every stacked tenant's request
+        context).  ``track`` overrides the record's worker/track label so
+        in-process servers (gateway, loopback netdb) render as their own
+        Perfetto track."""
         if not self.enabled:
             return
         try:
             record, duration = self._build_span_record(
-                name, start, duration, args, time.perf_counter()
+                name,
+                start,
+                duration,
+                args,
+                time.perf_counter(),
+                span_ctx=span_ctx,
+                parent_ctx=parent_ctx,
+                links=links,
+                track=track,
             )
             with self._lock:
                 TSAN.write("Telemetry._ring", self)
@@ -270,7 +438,18 @@ class Telemetry:
         except Exception:  # pragma: no cover - must never raise into hot path
             pass
 
-    def _build_span_record(self, name, start, duration, args, now):
+    def _build_span_record(
+        self,
+        name,
+        start,
+        duration,
+        args,
+        now,
+        span_ctx=None,
+        parent_ctx=None,
+        links=None,
+        track=None,
+    ):
         """THE span-record builder — shared by :meth:`record_span` and
         :meth:`record_spans_batch` so the None-start back-computation and
         the record schema cannot drift between the per-call and batched
@@ -289,6 +468,28 @@ class Telemetry:
         }
         if args:
             record["args"] = dict(args)
+        if span_ctx is None and parent_ctx is None:
+            ambient = current_trace_context()
+            if ambient is not None and ambient.sampled:
+                parent_ctx = ambient
+        if span_ctx is not None:
+            record["trace_id"] = span_ctx.trace_id
+            record["span_id"] = span_ctx.span_id
+            if parent_ctx is not None:
+                record["parent_span_id"] = parent_ctx.span_id
+        elif parent_ctx is not None and parent_ctx.sampled:
+            record["trace_id"] = parent_ctx.trace_id
+            record["span_id"] = os.urandom(8).hex()
+            record["parent_span_id"] = parent_ctx.span_id
+        if links:
+            record["links"] = [
+                {"trace_id": link.trace_id, "span_id": link.span_id}
+                if isinstance(link, TraceContext)
+                else dict(link)
+                for link in links
+            ]
+        if track is not None:
+            record["worker"] = track
         return record, float(duration)
 
     def record_spans_batch(self, entries):
@@ -297,17 +498,29 @@ class Telemetry:
         ``entries`` is ``[(name, start, duration, args), ...]`` with the
         same semantics as :meth:`record_span` (``start`` a perf_counter
         reading; a None start is back-computed from ``duration`` against
-        the batch's shared "now").  The producer buffers its per-sample
-        spans across a round and flushes them here — per-sample
-        ``record_span`` calls each paid a lock round-trip and a clock read
-        inside the hot loop (see ``bench.py``'s ``telemetry_us_saved``)."""
+        the batch's shared "now").  An optional fifth element carries the
+        :class:`TraceContext` that was ambient when the sample was taken
+        (``parent_ctx`` semantics — buffering must not re-read the ambient
+        at flush time, which may belong to a later round).  The producer
+        buffers its per-sample spans across a round and flushes them here —
+        per-sample ``record_span`` calls each paid a lock round-trip and a
+        clock read inside the hot loop (see ``bench.py``'s
+        ``telemetry_us_saved``)."""
         if not self.enabled or not entries:
             return
         try:
             now = time.perf_counter()
             records = [
-                (name,) + self._build_span_record(name, start, duration, args, now)
-                for name, start, duration, args in entries
+                (entry[0],)
+                + self._build_span_record(
+                    entry[0],
+                    entry[1],
+                    entry[2],
+                    entry[3],
+                    now,
+                    parent_ctx=entry[4] if len(entry) > 4 else None,
+                )
+                for entry in entries
             ]
             with self._lock:
                 TSAN.write("Telemetry._ring", self)
@@ -456,9 +669,19 @@ def chrome_trace_events(spans):
     when present — a bare OS pid collides across hosts, e.g. two
     containerized workers both running as pid 1), mapped to synthetic
     sequential pids; each track gets a process_name metadata event so
-    Perfetto labels the rows."""
+    Perfetto labels the rows.
+
+    Distributed-trace records additionally produce Perfetto FLOW events
+    (``s`` start / ``f`` finish pairs, bound by ``id``): one arrow per
+    cross-track parent→child edge (a client span whose ``span_id`` a
+    server span names as ``parent_span_id``), and one per recorded link
+    (the gateway's coalesced dispatch → every stacked tenant's request
+    context).  Each flow carries its ``trace_id`` in ``args`` so arrows
+    can be grepped back to the request they belong to."""
     events = []
     tracks = {}  # worker label -> synthetic pid
+    by_span_id = {}  # span_id -> its X event (for flow binding)
+    traced = []  # (span record, X event) pairs carrying trace fields
     for span in spans:
         if not span:
             continue
@@ -477,7 +700,55 @@ def chrome_trace_events(spans):
         args = span.get("args")
         if args:
             event["args"] = dict(args)
+        trace_id = span.get("trace_id")
+        if trace_id:
+            event.setdefault("args", {})["trace_id"] = trace_id
         events.append(event)
+        span_id = span.get("span_id")
+        if span_id:
+            by_span_id[span_id] = event
+        if (trace_id and span.get("parent_span_id")) or span.get("links"):
+            traced.append((span, event))
+    flow_seq = 0
+    for span, event in traced:
+        sources = []  # (source event, trace_id the arrow belongs to)
+        parent = by_span_id.get(span.get("parent_span_id"))
+        # Parent arrows only across tracks: intra-track nesting is already
+        # visible as slice containment, and drawing it would bury the
+        # cross-process arrows the merge exists to show.
+        if parent is not None and parent["pid"] != event["pid"]:
+            sources.append((parent, span.get("trace_id")))
+        for link in span.get("links") or ():
+            target = by_span_id.get((link or {}).get("span_id"))
+            if target is not None and target is not parent:
+                sources.append((target, (link or {}).get("trace_id")))
+        for source, flow_trace in sources:
+            flow_seq += 1
+            flow = {
+                "name": "trace",
+                "cat": "flow",
+                "id": flow_seq,
+                "args": {"trace_id": flow_trace},
+            }
+            events.append(
+                {
+                    **flow,
+                    "ph": "s",
+                    "ts": source["ts"],
+                    "pid": source["pid"],
+                    "tid": source["tid"],
+                }
+            )
+            events.append(
+                {
+                    **flow,
+                    "ph": "f",
+                    "bp": "e",
+                    "ts": event["ts"],
+                    "pid": event["pid"],
+                    "tid": event["tid"],
+                }
+            )
     for label, pid in tracks.items():
         events.append(
             {

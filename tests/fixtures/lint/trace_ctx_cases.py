@@ -1,0 +1,54 @@
+"""TEL005 fixture: wire-send paths that open spans must carry TraceContext.
+
+A function that puts bytes on a wire (``.sendall`` / ``_exchange*`` / the
+gateway's ``wfile.write`` reply writer) AND opens/records a span is a
+distributed-trace hop: without injecting the ambient context into the
+payload (client side) or adopting the wire's ``ctx`` field (server side),
+the other process records orphan spans and ``orion-tpu trace
+--distributed`` cannot join the tracks.
+"""
+
+from orion_tpu.telemetry import TELEMETRY, TraceContext, current_trace_context
+
+
+def bad_span_around_send(sock, payload):
+    with TELEMETRY.span("net.send"):  # expect: TEL005
+        sock.sendall(payload)
+
+
+def bad_record_span_on_exchange_path(client, line, t0):
+    response = client._exchange(line)
+    TELEMETRY.record_span("net.exchange", start=t0)  # expect: TEL005
+    return response
+
+
+def bad_reply_writer_span(handler, reply, t0):
+    handler.wfile.write(reply)
+    TELEMETRY.record_span("net.reply", start=t0)  # expect: TEL005
+
+
+def good_injecting_client(sock, request, encode):
+    trace = current_trace_context()
+    if trace is not None:
+        request["ctx"] = trace.to_wire()
+    with TELEMETRY.span("net.send"):
+        sock.sendall(encode(request))
+
+
+def good_adopting_server(handler, request, reply, t0):
+    trace = TraceContext.from_wire(request.get("ctx"))
+    handler.wfile.write(reply)
+    TELEMETRY.record_span("net.reply", start=t0, parent_ctx=trace)
+
+
+def good_span_off_the_wire_path(t0):
+    # No wire send in this function: an explicit span needs no context
+    # plumbing of its own (the ambient rule already parents it).
+    TELEMETRY.record_span("host.phase", start=t0)
+
+
+def good_send_without_spans(sock, payload):
+    # Wire send with no span: nothing to join, nothing to flag (the
+    # histogram-only observe path stays quiet).
+    sock.sendall(payload)
+    TELEMETRY.observe("net.rtt", 0.001)

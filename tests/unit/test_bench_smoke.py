@@ -141,6 +141,19 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
         # as q ops, not O(1).
         assert payload["storage_ops_per_round"][backend] <= 2, backend
 
+    # --- distributed-trace critical-path attribution ---------------------
+    # The traced rounds (incl. the loopback-netdb leg) bucket each round's
+    # wall time into client-host / wire / server-host / device
+    # (orion_tpu.tracing) — the ROADMAP item-2 burn-down measurement.
+    attribution = payload["host_attribution"]
+    assert attribution is not None and attribution["traces"] >= 1
+    for key in (
+        "total_ms", "client_host_ms", "wire_ms", "server_host_ms", "device_ms",
+    ):
+        assert attribution[key] is not None and attribution[key] >= 0, key
+    # The netdb leg really crossed a wire: server-side host time was seen.
+    assert attribution["server_host_ms"] > 0
+
     # --- the telemetry trace artifact ------------------------------------
     assert payload["trace_file"] == str(trace_path)
     with open(trace_path) as handle:
@@ -162,6 +175,26 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
         for c in commits
         for w in windows
     ), "storage.commit no longer overlaps the device.dispatch window"
+    # Distributed tracing: the trace carries >= 1 CROSS-PROCESS flow pair
+    # (bound s/f events on different synthetic tracks) — the serve leg's
+    # client->gateway hops and the netdb leg's commit->apply hops both
+    # produce them, and the serve-leg spans must be among the arrows'
+    # endpoints (the coalesced-dispatch links / gateway request spans).
+    starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+    finishes = {e["id"]: e for e in events if e.get("ph") == "f"}
+    pairs = [(starts[i], finishes[i]) for i in set(starts) & set(finishes)]
+    assert pairs, "bench trace lost its distributed flow events"
+    assert any(s["pid"] != f["pid"] for s, f in pairs), (
+        "no flow pair crosses process tracks"
+    )
+    serve_tracks = {
+        e["pid"]
+        for e in events
+        if e.get("ph") == "M" and "gateway:" in str(e.get("args", {}).get("name", ""))
+    }
+    assert any(
+        s["pid"] in serve_tracks or f["pid"] in serve_tracks for s, f in pairs
+    ), "the serve leg contributed no cross-process flow link"
 
 
 def test_bench_chaos_smoke_reports_retries_and_audits_clean(repo_root):

@@ -1,0 +1,275 @@
+"""Metrics export plane: Prometheus exposition goldens (pinned against
+merge_snapshots semantics), label escaping, cumulative-``le`` monotonicity,
+the /metrics + /healthz HTTP server, and the `orion-tpu metrics` CLI."""
+
+import json
+import urllib.request
+
+import pytest
+
+from orion_tpu.metrics import (
+    MetricsServer,
+    escape_label_value,
+    render_exposition,
+    sanitize_name,
+)
+from orion_tpu.telemetry import N_BUCKETS, Telemetry, merge_snapshots
+
+
+def _hist(bucket_counts, total_sum):
+    buckets = [0] * N_BUCKETS
+    for index, count in bucket_counts.items():
+        buckets[index] = count
+    count = sum(bucket_counts.values())
+    return {
+        "buckets": buckets,
+        "count": count,
+        "sum": total_sum,
+        "min": 0.0,
+        "max": 1.0,
+    }
+
+
+def _snapshot(retries, lag, round_buckets, round_sum):
+    return {
+        "counters": {"storage.retries": retries, "jax.retraces": 1},
+        "gauges": {"pacemaker.heartbeat_lag_s": lag},
+        "histograms": {"producer.round": _hist(round_buckets, round_sum)},
+    }
+
+
+#: THE exposition golden: two worker snapshots merged exactly as
+#: `orion-tpu info`/`metrics` merge them (counters/buckets SUM, gauges
+#: MAX), then rendered.  Every formatting decision is load-bearing for
+#: scrapers — a drifted line is a broken dashboard, so the comparison is
+#: exact text, not "contains".
+GOLDEN = """\
+# TYPE orion_tpu_jax_retraces_total counter
+orion_tpu_jax_retraces_total 2
+# TYPE orion_tpu_storage_retries_total counter
+orion_tpu_storage_retries_total 5
+# TYPE orion_tpu_pacemaker_heartbeat_lag_s gauge
+orion_tpu_pacemaker_heartbeat_lag_s 7.5
+# TYPE orion_tpu_producer_round_seconds histogram
+orion_tpu_producer_round_seconds_bucket{le="1e-06"} 0
+orion_tpu_producer_round_seconds_bucket{le="2e-06"} 0
+orion_tpu_producer_round_seconds_bucket{le="4e-06"} 0
+orion_tpu_producer_round_seconds_bucket{le="8e-06"} 0
+orion_tpu_producer_round_seconds_bucket{le="1.6e-05"} 0
+orion_tpu_producer_round_seconds_bucket{le="3.2e-05"} 0
+orion_tpu_producer_round_seconds_bucket{le="6.4e-05"} 0
+orion_tpu_producer_round_seconds_bucket{le="0.000128"} 0
+orion_tpu_producer_round_seconds_bucket{le="0.000256"} 0
+orion_tpu_producer_round_seconds_bucket{le="0.000512"} 0
+orion_tpu_producer_round_seconds_bucket{le="0.001024"} 3
+orion_tpu_producer_round_seconds_bucket{le="0.002048"} 4
+orion_tpu_producer_round_seconds_bucket{le="0.004096"} 6
+orion_tpu_producer_round_seconds_bucket{le="+Inf"} 6
+orion_tpu_producer_round_seconds_sum 0.75
+orion_tpu_producer_round_seconds_count 6
+"""
+
+
+def test_exposition_golden_pinned_against_merge_snapshots():
+    merged = merge_snapshots(
+        [
+            _snapshot(2, 7.5, {10: 2, 12: 1}, 0.5),
+            _snapshot(3, 0.4, {10: 1, 11: 1, 12: 1}, 0.25),
+        ]
+    )
+    assert render_exposition(merged) == GOLDEN
+
+
+def test_le_buckets_are_cumulative_and_monotone():
+    snapshot = {"histograms": {"op": _hist({3: 2, 7: 1, 9: 4}, 0.5)}}
+    lines = render_exposition(snapshot).splitlines()
+    values = [
+        (line.split('le="')[1].split('"')[0], int(line.rsplit(" ", 1)[1]))
+        for line in lines
+        if "_bucket{" in line
+    ]
+    counts = [v for _, v in values]
+    assert counts == sorted(counts), "cumulative le buckets must be monotone"
+    assert values[-1][0] == "+Inf" and counts[-1] == 7
+    # le labels themselves ascend numerically up to +Inf.
+    uppers = [float(le) for le, _ in values[:-1]]
+    assert uppers == sorted(uppers)
+    # _sum/_count close the family.
+    assert any(line == "op_sum 0.5" or line.endswith("_sum 0.5") for line in lines)
+    assert any(line.endswith("_count 7") for line in lines)
+
+
+def test_tenant_histograms_export_as_labeled_family_with_escaping():
+    evil = 'exp"v\\1\nx'
+    snapshot = {
+        "histograms": {
+            f"serve.tenant.{evil}.request": _hist({5: 2}, 0.001),
+            "serve.tenant.plain-v1.request": _hist({5: 1}, 0.0005),
+        }
+    }
+    body = render_exposition(snapshot)
+    # ONE family, two labeled series — not one metric name per tenant.
+    assert body.count("# TYPE orion_tpu_serve_tenant_request_seconds") == 1
+    assert 'tenant="plain-v1"' in body
+    escaped = escape_label_value(evil)
+    assert f'tenant="{escaped}"' in body
+    assert escaped == 'exp\\"v\\\\1\\nx'
+    # The raw control characters never appear inside a label value.
+    for line in body.splitlines():
+        if "tenant=" in line:
+            assert "\n" not in line.split("tenant=")[1]
+
+
+def test_sanitize_name_rules():
+    assert sanitize_name("storage.network.rtt") == "storage_network_rtt"
+    assert sanitize_name("a-b c/d") == "a_b_c_d"
+    assert sanitize_name("0weird") == "_0weird"
+
+
+def test_metrics_http_server_serves_exposition_and_healthz():
+    registry = Telemetry(enabled=True)
+    registry.count("serve.suggests", 4)
+    registry.set_gauge("memory.device_live_bytes", 1024)
+    registry.observe("serve.request", 0.002)
+    server = MetricsServer(
+        port=0,
+        registry=registry,
+        healthz=lambda: {"ok": True, "queue_depth": 2, "tenants": 3},
+    )
+    host, port = server.start()
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}/metrics") as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "orion_tpu_serve_suggests_total 4" in body
+        assert "orion_tpu_memory_device_live_bytes 1024" in body
+        assert 'orion_tpu_serve_request_seconds_bucket{le="+Inf"} 1' in body
+        with urllib.request.urlopen(f"http://{host}:{port}/healthz") as resp:
+            payload = json.loads(resp.read())
+        assert payload == {"ok": True, "queue_depth": 2, "tenants": 3}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://{host}:{port}/nope")
+    finally:
+        server.stop()
+
+
+def test_gateway_metrics_port_serves_healthz():
+    from orion_tpu.serve.gateway import GatewayServer
+
+    gateway = GatewayServer(port=0, metrics_port=0)
+    gateway.serve_background()
+    try:
+        mhost, mport = gateway._metrics_server.address
+        with urllib.request.urlopen(f"http://{mhost}:{mport}/healthz") as resp:
+            payload = json.loads(resp.read())
+        assert payload["ok"] is True
+        assert payload["tenants"] == 0 and payload["queue_depth"] == 0
+        with urllib.request.urlopen(f"http://{mhost}:{mport}/metrics") as resp:
+            assert resp.status == 200
+    finally:
+        gateway.shutdown()
+        gateway.server_close()
+
+
+def test_worker_server_enables_telemetry_and_falls_back_when_port_taken(
+    monkeypatch,
+):
+    """A worker that asked for a scrape endpoint must actually export
+    metrics (the registry is enabled on start), and the hunt --n-workers
+    shape — every child inheriting ONE configured port — degrades to an
+    ephemeral port instead of silently exporting nothing."""
+    from orion_tpu import metrics as metrics_mod
+    from orion_tpu.telemetry import TELEMETRY
+
+    was_enabled = TELEMETRY.enabled
+    monkeypatch.setattr(metrics_mod, "_worker_server", None)
+    blocker = MetricsServer(port=0)
+    blocker.start()
+    server = None
+    try:
+        server = metrics_mod.ensure_worker_metrics_server(port=blocker.port)
+        assert server is not None
+        assert server.port != blocker.port  # ephemeral fallback bound
+        assert TELEMETRY.enabled  # the endpoint exports a LIVE registry
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/healthz"
+        ) as resp:
+            assert json.loads(resp.read())["ok"] is True
+        # Idempotent: a second call reuses the singleton.
+        assert metrics_mod.ensure_worker_metrics_server(port=1) is server
+    finally:
+        blocker.stop()
+        if server is not None:
+            server.stop()
+        monkeypatch.setattr(metrics_mod, "_worker_server", None)
+        if not was_enabled:
+            TELEMETRY.disable()
+
+
+def test_gateway_metrics_bind_failure_does_not_leak_the_gateway_socket():
+    """A taken --metrics-port fails GatewayServer construction, but the
+    already-bound gateway socket is released (a rebind on the same port
+    succeeds immediately)."""
+    from orion_tpu.serve.gateway import GatewayServer
+
+    blocker = MetricsServer(port=0)
+    blocker.start()
+    try:
+        with pytest.raises(OSError):
+            GatewayServer(port=0, metrics_port=blocker.port)
+        # A fresh gateway starts fine afterwards — nothing was leaked in a
+        # way that blocks normal operation.
+        gateway = GatewayServer(port=0, metrics_port=0)
+        gateway.serve_background()
+        gateway.shutdown()
+        gateway.server_close()
+    finally:
+        blocker.stop()
+
+
+def test_metrics_cli_renders_merged_exposition(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+    from orion_tpu.storage.base import create_storage
+
+    db_path = str(tmp_path / "metrics.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment(
+        {"name": "metrics-exp", "metadata": {"user": "u"}}
+    )
+    for worker, retries in (("w-a:1", 2), ("w-b:2", 3)):
+        storage.record_metrics(
+            exp,
+            {
+                "counters": {"storage.retries": retries},
+                "gauges": {"pacemaker.heartbeat_lag_s": 0.1},
+                "histograms": {},
+            },
+            worker=worker,
+        )
+    rc = cli_main(["metrics", "-n", "metrics-exp", "--storage-path", db_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "orion_tpu_storage_retries_total 5" in out  # merged SUM
+    # --out writes the same body to a file (textfile-collector handoff).
+    out_path = tmp_path / "expo.prom"
+    rc = cli_main(
+        [
+            "metrics", "-n", "metrics-exp", "--storage-path", db_path,
+            "--out", str(out_path),
+        ]
+    )
+    assert rc == 0
+    assert "orion_tpu_storage_retries_total 5" in out_path.read_text()
+
+
+def test_metrics_cli_without_data_errors(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+    from orion_tpu.storage.base import create_storage
+
+    db_path = str(tmp_path / "empty.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    storage.create_experiment({"name": "quiet", "metadata": {"user": "u"}})
+    rc = cli_main(["metrics", "-n", "quiet", "--storage-path", db_path])
+    assert rc == 1
+    assert "no metrics recorded" in capsys.readouterr().out

@@ -143,6 +143,87 @@ def test_render_top_degrades_with_partial_data(tmp_path):
     assert "workers: 0" in frame2
 
 
+def test_top_flush_age_staleness_and_memory_column(tmp_path):
+    """The staleness satellite: each worker row carries the AGE of its
+    last metrics/health flush; past 3× METRICS_FLUSH_INTERVAL the worker
+    is marked stale (the MAX-merged gauges hide WHICH worker went quiet),
+    and the device-memory gauge surfaces as the mem column."""
+    import time as _time
+
+    from orion_tpu.cli.top import STALE_AFTER
+
+    storage = create_storage({"type": "memory"})
+    exp = storage.create_experiment({"name": "s", "metadata": {"user": "u"}})
+    now = _time.time()
+    storage.record_metrics(
+        exp,
+        {
+            "counters": {},
+            "gauges": {"memory.device_live_bytes": 5e6},
+            "histograms": {},
+        },
+        worker="fresh:1",
+    )
+    storage.record_metrics(
+        exp, {"counters": {}, "gauges": {}, "histograms": {}}, worker="quiet:2"
+    )
+    # Backdate the quiet worker's flush well past the staleness bar.
+    storage._db.write(
+        "metrics",
+        {"time": now - 10 * STALE_AFTER},
+        query={"experiment": exp["_id"], "worker": "quiet:2"},
+    )
+    storage.record_health(
+        exp, {"round": 1, "best_y": 0.5, "time": now}, worker="fresh:1"
+    )
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "s"
+            self.version = 1
+            self.id = exp["_id"]
+
+    snap = snapshot_top(_Exp(), now=now + 1.0)
+    fresh, quiet = snap["workers"]["fresh:1"], snap["workers"]["quiet:2"]
+    assert fresh["stale"] is False and fresh["flush_age_s"] <= STALE_AFTER
+    assert quiet["stale"] is True and quiet["flush_age_s"] > STALE_AFTER
+    assert fresh["mem_mb"] == pytest.approx(5.0)
+    assert quiet["mem_mb"] is None
+    frame = render_top(snap)
+    assert "mem MB" in frame and "age" in frame
+    assert "STALE" in frame and "quiet:2" in frame.split("STALE")[1]
+
+
+def test_info_per_worker_shows_flush_age_and_stale_marker(tmp_path, capsys):
+    import time as _time
+
+    from orion_tpu.cli import main as cli_main
+    from orion_tpu.cli.top import STALE_AFTER
+
+    db_path = str(tmp_path / "stale.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment({"name": "st", "metadata": {"user": "u"}})
+    storage.record_metrics(
+        exp,
+        {"counters": {"jax.retraces": 1}, "gauges": {}, "histograms": {}},
+        worker="gone:9",
+    )
+    storage._db.write(
+        "metrics",
+        {"time": _time.time() - 10 * STALE_AFTER},
+        query={"experiment": exp["_id"], "worker": "gone:9"},
+    )
+    rc = cli_main(["info", "-n", "st", "--storage-path", db_path, "--per-worker"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "last flush" in out and "STALE" in out
+    # The merged (default) view names the quiet worker too.
+    rc = cli_main(["info", "-n", "st", "--storage-path", db_path])
+    assert rc == 0
+    assert "STALE workers" in capsys.readouterr().out
+
+
 def test_sparkline_shapes():
     assert sparkline([]) == ""
     assert sparkline([1.0]) == "▁"
