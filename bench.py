@@ -1090,6 +1090,87 @@ def main_chaos(rounds=6, q=8, seed=11):
     print(json.dumps(payload))
 
 
+def run_soak(n_workers=1000, n_experiments=24, trials_per_worker=3,
+             n_routers=32, replicas=2, periodic_chaos=True, deadline=600.0):
+    """The sharded control-plane load harness (ROADMAP item 3): drive
+    ``n_workers`` simulated workers through consistent-hash routers
+    against an in-process 3-shard x ``replicas``-replica topology of REAL
+    netdb servers, under fault-proxy reconnect storms/partitions, a
+    scripted mid-run shard restart, and a replica kill.  Hard-asserts the
+    pass bar (zero lost observations, clean audits through the router AND
+    on every shard, chaos signals counted) and returns the summary block
+    for the payload.  SystemExit, not assert: the gate must hold under
+    ``python -O`` too."""
+    import tempfile
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.storage.soak import SoakTopology, drive_soak
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-soak-") as tmpdir:
+            topo = SoakTopology(
+                n_shards=3, replicas=replicas, persist_dir=tmpdir
+            )
+
+            def chaos_once():
+                topo.drop_all()
+                topo.shards[1].restart_primary()
+                # Replica 0 of EVERY shard dies so the read path's
+                # failover leg fires regardless of where the ring placed
+                # the experiments (multi-replica topologies keep serving
+                # replica reads from the survivors).
+                for shard in topo.shards:
+                    shard.kill_replica(0)
+
+            try:
+                result = drive_soak(
+                    topo,
+                    n_workers=n_workers,
+                    n_experiments=n_experiments,
+                    trials_per_worker=trials_per_worker,
+                    n_routers=n_routers,
+                    chaos=periodic_chaos,
+                    chaos_period=1.0,
+                    mid_hook=chaos_once,
+                    deadline=deadline,
+                )
+            finally:
+                topo.stop()
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    summary = result.summary()
+    if result.lost_observations != 0:
+        raise SystemExit(f"soak LOST observations: {summary}")
+    if not result.audits_clean:
+        raise SystemExit(f"soak audits dirty: {summary}")
+    if sum(result.completed_per_shard.values()) != result.completed:
+        raise SystemExit(f"router view != sum of shards: {summary}")
+    if result.restarts < 1 or result.failovers < 1 or result.reconnects < 1:
+        raise SystemExit(f"soak chaos signals never fired: {summary}")
+    summary["trials_per_second"] = (
+        round(result.completed / result.duration_s, 1)
+        if result.duration_s else None
+    )
+    return summary
+
+
+def main_soak(n_workers=1000):
+    """``bench.py --soak [--workers N]``: the 1000-worker headline run."""
+    summary = run_soak(n_workers=n_workers)
+    payload = {
+        "metric": (
+            f"sharded soak: {n_workers} workers, 3 shards x 2 replicas, "
+            "storms+partition+restart"
+        ),
+        "n_workers": n_workers,
+        "soak": summary,
+    }
+    print(json.dumps(payload))
+
+
 def lint_preflight():
     """Self-lint the tree before timing anything: bench numbers taken on a
     contract-violating tree (a host sync inside the fused step, a storage
@@ -1180,6 +1261,15 @@ def main_smoke(trace_out="bench_trace.json"):
             "serve leg failed the concurrency sanitizer:\n"
             + tsan_report.format_human()
         )
+    # Tiny sharded-soak leg (storage/shard.py + soak.py): 8 workers over a
+    # real 3-shard x 1-replica topology with the scripted storm + shard
+    # restart + replica kill — run_soak hard-asserts zero lost
+    # observations, clean audits on every shard, and that the chaos
+    # signals (restart, failover, reconnects) actually fired.
+    soak_block = run_soak(
+        n_workers=8, n_experiments=4, trials_per_worker=4, n_routers=2,
+        replicas=1, periodic_chaos=False, deadline=120.0,
+    )
     trace_file, host_attribution = _safe_trace(trace_out)
     payload = _json_payload(
         metric=(
@@ -1205,6 +1295,7 @@ def main_smoke(trace_out="bench_trace.json"):
     payload["lint_violations"] = lint_violations
     payload["tsan_violations"] = tsan_report.violation_count()
     payload["serve"] = serve_block
+    payload["soak"] = soak_block
     _warn_host_budget(payload)
     print(json.dumps(payload))
 
@@ -1221,6 +1312,14 @@ if __name__ == "__main__":
         out = argv[at + 1]
     if "--chaos" in argv:
         main_chaos()
+    elif "--soak" in argv:
+        workers = 1000
+        if "--workers" in argv:
+            at = argv.index("--workers")
+            if at + 1 >= len(argv):
+                sys.exit("bench.py: --workers requires a count argument")
+            workers = int(argv[at + 1])
+        main_soak(n_workers=workers)
     elif "--serve" in argv:
         main_serve(smoke="--smoke" in argv)
     else:

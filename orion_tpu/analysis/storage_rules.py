@@ -1,4 +1,4 @@
-"""Storage retry/trace coverage rules (``STO001``–``STO003``).
+"""Storage retry/trace coverage rules (``STO001``–``STO004``).
 
 PR 5 unified failure semantics on one invariant: every storage protocol op
 rides the shared :class:`~orion_tpu.storage.retry.RetryPolicy` through the
@@ -8,6 +8,13 @@ ops can refuse a blind re-send.  A new protocol op that skips the decorator
 silently reverts to pre-policy crash-on-transient behavior; a new
 ``DatabaseError`` raised after bytes may have hit the wire without the
 flag silently turns CAS retries unsafe.  These rules pin both.
+
+``STO004`` extends the discipline to the sharded router
+(``storage/shard.py``): a fan-out method that aggregates per-shard
+``DatabaseError``\\ s must propagate the STRICTEST ``maybe_applied`` of
+its parts — one shard's ambiguous loss makes the whole fan-out ambiguous,
+and a summary error raised without the merged verdict silently launders a
+maybe-applied mutation into a blindly-retriable one.
 """
 
 import ast
@@ -219,4 +226,117 @@ class AmbiguousWireError(Rule):
         return False
 
 
-STORAGE_RULES = (UncoveredStorageOp, ImplicitRetryMode, AmbiguousWireError)
+#: Blessed aggregation surfaces for STO004: the error constructor that
+#: stamps the merged verdict itself, and the merge predicate a hand-built
+#: error may assign ``maybe_applied`` from.
+_FANOUT_ERROR_BUILDERS = frozenset({"shard_fanout_error"})
+_MERGE_PREDICATES = frozenset({"merge_maybe_applied"})
+
+
+class UnmergedFanoutError(Rule):
+    id = "STO004"
+    name = "unmerged-fanout-error"
+    description = (
+        "In a shard-routing class (name contains 'Sharded') or a fan-out "
+        "helper (name contains 'fan_out'/'fanout'), every DatabaseError "
+        "raised must carry the strictest maybe_applied of the per-shard "
+        "parts: raise shard_fanout_error(...) (which merges internally), "
+        "or assign .maybe_applied from merge_maybe_applied(...) before "
+        "raising.  An unmerged summary error would let the retry policy "
+        "blind-resend a mutation one shard may already have applied."
+    )
+
+    def _fanout_functions(self, tree):
+        """(owner, fn) pairs in scope: methods of Sharded* classes plus any
+        function whose own name marks it a fan-out helper."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef) and "Sharded" in node.name:
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        yield node.name, item
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                lowered = node.name.lower()
+                if "fan_out" in lowered or "fanout" in lowered:
+                    yield None, node
+
+    def _merged_names(self, fn):
+        """Names whose error carries a merged verdict: assigned from a
+        blessed builder, or whose .maybe_applied is assigned from a merge
+        predicate call."""
+        merged = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            callee = (dotted_name(node.value.func) or "").split(".")[-1]
+            for target in node.targets:
+                if isinstance(target, ast.Name) and callee in _FANOUT_ERROR_BUILDERS:
+                    merged.add(target.id)
+                if (
+                    isinstance(target, ast.Attribute)
+                    and target.attr == "maybe_applied"
+                    and isinstance(target.value, ast.Name)
+                    and callee in _MERGE_PREDICATES
+                ):
+                    merged.add(target.value.id)
+        return merged
+
+    def check(self, module):
+        seen = set()
+        for owner, fn in self._fanout_functions(module.tree):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            merged = self._merged_names(fn)
+            where = f"{owner}.{fn.name}" if owner else fn.name
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                if isinstance(exc, ast.Call):
+                    callee = (dotted_name(exc.func) or "").split(".")[-1]
+                    if callee in _FANOUT_ERROR_BUILDERS:
+                        continue
+                    if callee == "DatabaseError":
+                        yield Diagnostic(
+                            module.path,
+                            node.lineno,
+                            node.col_offset,
+                            self.id,
+                            f"DatabaseError raised inline in fan-out scope "
+                            f"'{where}' without the merged per-shard "
+                            "maybe_applied; raise shard_fanout_error(...) "
+                            "or assign .maybe_applied from "
+                            "merge_maybe_applied(...) first",
+                        )
+                elif isinstance(exc, ast.Name) and exc.id not in merged:
+                    if self._binds_database_error(fn, exc.id):
+                        yield Diagnostic(
+                            module.path,
+                            node.lineno,
+                            node.col_offset,
+                            self.id,
+                            f"DatabaseError variable {exc.id!r} raised in "
+                            f"fan-out scope '{where}' without its "
+                            "maybe_applied merged from the per-shard parts "
+                            "(merge_maybe_applied / shard_fanout_error)",
+                        )
+
+    def _binds_database_error(self, fn, name):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                callee = (dotted_name(node.value.func) or "").split(".")[-1]
+                if callee == "DatabaseError" and any(
+                    isinstance(t, ast.Name) and t.id == name for t in node.targets
+                ):
+                    return True
+        return False
+
+
+STORAGE_RULES = (
+    UncoveredStorageOp,
+    ImplicitRetryMode,
+    AmbiguousWireError,
+    UnmergedFanoutError,
+)

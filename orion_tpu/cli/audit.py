@@ -102,11 +102,22 @@ def main(args):
         timeout = args.timeout
         if timeout is None:
             timeout = config.get("heartbeat")
+        from orion_tpu.cli.base import describe_storage_topology
+
+        topology = describe_storage_topology()
+        if topology is not None:
+            # The --all sweep resolved through the sharded router: every
+            # shard's experiments are in the report set, and each one is
+            # labeled with its ring placement below.
+            print(topology)
         reports = audit_storage(storage, lost_timeout=timeout)
         if not reports:
             print("no experiments in storage")
             return 0
+        shard_for = getattr(storage.db, "shard_for", None)
         for report in reports:
+            if shard_for is not None:
+                print(f"[shard {shard_for(report.experiment_id)}]", end=" ")
             print(report.summary())
         failed = [r for r in reports if not r.ok]
         for report in failed:

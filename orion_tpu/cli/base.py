@@ -160,6 +160,30 @@ def build_all_experiments(args, view=True):
     return experiments
 
 
+def describe_storage_topology():
+    """One-line sharded-topology summary of the ACTIVE storage singleton
+    (``audit``/``info``/``top`` fleet views print it so an operator can
+    tell at a glance WHICH plane answered), or None when the storage is
+    not the consistent-hash router."""
+    from orion_tpu.storage.base import _storage_singleton
+
+    db = getattr(_storage_singleton, "db", None)
+    describe = getattr(db, "describe_topology", None)
+    if describe is None:
+        return None
+    topology = describe()
+    parts = ", ".join(
+        f"s{shard['index']}={shard['address']}"
+        + (f"(+{len(shard['replicas'])}r)" if shard["replicas"] else "")
+        for shard in topology["shards"]
+    )
+    return (
+        f"storage: {len(topology['shards'])} shard(s) [{parts}] "
+        f"vnodes={topology['vnodes']} replica_reads="
+        f"{'on' if topology['replica_reads'] else 'off'}"
+    )
+
+
 def build_from_args(args, need_user_args=True, allow_create=True, view=False):
     """CLI args -> (experiment, cmdline_parser), with storage wired up.
 

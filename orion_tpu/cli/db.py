@@ -51,7 +51,32 @@ def add_subparser(subparsers):
         "only — any peer that can reach the port can read and corrupt "
         "experiments)",
     )
+    serve_p.add_argument(
+        "--replicate-to",
+        action="append",
+        default=None,
+        metavar="host:port",
+        help="push this server's ordered mutation stream to a read replica "
+        "(repeatable; replicas serve the hot read path of a sharded "
+        "topology — see docs/multi_node.md).  Replication is asynchronous: "
+        "writes are acknowledged before they reach any replica.",
+    )
+    serve_p.add_argument(
+        "--replica",
+        action="store_true",
+        help="mark this server a read replica (stamps its applied "
+        "replication sequence on read replies so clients detect lag; also "
+        "set automatically when a primary's stream arrives)",
+    )
     serve_p.set_defaults(func=main_serve)
+
+    ring_p = sub.add_parser(
+        "ring",
+        help="show the sharded storage topology and per-experiment "
+        "ring placement (requires a shards: stanza / ORION_DB_SHARDS)",
+    )
+    _common(ring_p)
+    ring_p.set_defaults(func=main_ring)
 
     copy_p = sub.add_parser(
         "copy",
@@ -628,7 +653,50 @@ def main_serve(args):
             file=sys.stderr,
         )
         return 1
-    serve(host=args.host, port=args.port, persist=args.persist, secret=secret)
+    serve(
+        host=args.host,
+        port=args.port,
+        persist=args.persist,
+        secret=secret,
+        replicate_to=args.replicate_to,
+        replica=args.replica,
+    )
+    return 0
+
+
+def main_ring(args):
+    """`db ring`: the operator's placement oracle — which shard owns each
+    experiment, and what the topology looks like, computed from the SAME
+    ring every router instance builds (no server round trips needed for
+    the placement itself; the experiment list is read through the
+    router)."""
+    from orion_tpu.cli.base import describe_storage_topology
+
+    config = load_cli_config(args)
+    storage = setup_storage(config["storage"], force=True)
+    router = storage.db
+    if not hasattr(router, "describe_topology"):
+        print(
+            "storage is not sharded; add a `shards:` stanza to the storage "
+            "config (or set ORION_DB_SHARDS) — see docs/multi_node.md"
+        )
+        return 1
+    print(describe_storage_topology())
+    topology = router.describe_topology()
+    for shard in topology["shards"]:
+        replicas = ", ".join(shard["replicas"]) or "none"
+        print(f"  shard {shard['index']}: {shard['address']}  replicas: {replicas}")
+    docs = storage.fetch_experiments({})
+    if not docs:
+        print("no experiments in storage")
+        return 0
+    print(f"{len(docs)} experiment(s):")
+    for doc in sorted(docs, key=lambda d: (d["name"], d.get("version", 1))):
+        shard = router.shard_for(doc["_id"])
+        print(
+            f"  {doc['name']} v{doc.get('version', 1)} "
+            f"({doc['_id']}) -> shard {shard}"
+        )
     return 0
 
 

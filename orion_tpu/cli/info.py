@@ -296,6 +296,14 @@ def main(args):
     per_worker = getattr(args, "per_worker", False)
     if getattr(args, "all", False):
         experiments = build_all_experiments(args)
+        # Fleet view over a sharded control plane: say which topology
+        # answered (build_all_experiments resolved through the router, so
+        # experiments from EVERY shard are in the list).
+        from orion_tpu.cli.base import describe_storage_topology
+
+        topology = describe_storage_topology()
+        if topology is not None:
+            print(topology)
         if not experiments:
             print("no experiments in storage")
             return 0

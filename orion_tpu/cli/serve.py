@@ -78,13 +78,49 @@ def add_subparser(subparsers):
         "telemetry registry) and /healthz (queue depth, tenant count) on "
         "this port",
     )
+    parser.add_argument(
+        "--secret-file",
+        default=None,
+        metavar="path",
+        help="file holding the shared secret clients must prove knowledge "
+        "of (the netdb HMAC handshake on the gateway wire).  Clients set "
+        "ORION_SERVE_SECRET_FILE or serve.secret_file.",
+    )
+    parser.add_argument(
+        "--no-auth",
+        action="store_true",
+        help="explicitly run WITHOUT authentication (localhost development "
+        "only — any peer that can reach the port can drive every tenant's "
+        "suggestion stream)",
+    )
     parser.set_defaults(func=main)
     return parser
 
 
 def main(args):  # pragma: no cover - thin CLI shim over serve()
+    import sys
+
     from orion_tpu.serve.gateway import serve
 
+    secret = None
+    if args.secret_file:
+        from orion_tpu.storage.base import resolve_wire_secret
+
+        secret = resolve_wire_secret(
+            {"secret_file": args.secret_file},
+            env_prefix="ORION_SERVE",
+            what="serve gateway",
+        )
+    elif not args.no_auth:
+        # Secure by default, same contract as `db serve`: an open gateway
+        # hands every tenant's suggestion stream to anyone on the network.
+        print(
+            "ERROR: refusing to serve without authentication.  Pass "
+            "--secret-file <path> (recommended), or --no-auth for "
+            "localhost development.",
+            file=sys.stderr,
+        )
+        return 1
     if args.metrics_port is not None:
         # Asking for a scrape endpoint IS asking for metrics: a gateway
         # started with --metrics-port but without ORION_TPU_TELEMETRY
@@ -103,5 +139,6 @@ def main(args):  # pragma: no cover - thin CLI shim over serve()
         pending_limit=args.pending_limit,
         persist=args.persist,
         metrics_port=args.metrics_port,
+        secret=secret,
     )
     return 0

@@ -322,12 +322,15 @@ def render_fleet(snaps):
         f"{'experiment':<28} {'workers':>7} {'records':>7} {'rounds':>6} "
         f"{'best_y':>12} {'retry':>5} {'reconn':>6}"
     )
-    lines = [
-        f"orion-tpu top --all   experiments: {len(snaps)}",
-        "",
-        header,
-        "-" * len(header),
-    ]
+    lines = [f"orion-tpu top --all   experiments: {len(snaps)}"]
+    from orion_tpu.cli.base import describe_storage_topology
+
+    topology = describe_storage_topology()
+    if topology is not None:
+        # The fleet the table shows spans every shard (the router resolved
+        # it); the header says so.
+        lines.append(topology)
+    lines += ["", header, "-" * len(header)]
     for snap in snaps:
         rounds = sum(row["rounds"] for row in snap["workers"].values())
         retries = sum(row["retries"] for row in snap["workers"].values())

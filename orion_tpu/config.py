@@ -86,6 +86,12 @@ DEFAULTS = {
     # base_delay, max_delay, multiplier, jitter, deadline — the
     # RetryPolicy defaults apply for any omitted key; docs/robustness.md);
     # `retry: false` disables storage-level retries entirely.
+    # A network storage section may also carry the sharded-topology stanza
+    # (docs/multi_node.md): `shards:` — a list of "host:port" strings or
+    # {address|host/port, replicas: [...]} dicts (consistent-hash routing
+    # on experiment id, read-replica fan-out) — plus the router knobs
+    # `vnodes`, `replica_reads`, `shard_retry`, `reconnect_jitter`.  The
+    # ORION_DB_SHARDS env var carries the replica-less spelling.
     "storage": {"type": "pickled", "path": "orion_tpu_db.pkl", "retry": {}},
     # Framework telemetry (orion_tpu.telemetry): None = leave the
     # process-wide registry as the ORION_TPU_TELEMETRY env var set it;
@@ -113,6 +119,13 @@ def _env_config():
     db_type = os.getenv("ORION_DB_TYPE")
     if db_type:
         storage["type"] = db_type
+    shards = os.getenv("ORION_DB_SHARDS")
+    if shards:
+        # Sharded control plane (storage/shard.py): a comma-separated list
+        # of primary host:port addresses; per-shard replicas need the
+        # config-file `shards:` stanza (see docs/multi_node.md).
+        storage.setdefault("type", "network")
+        storage["shards"] = [s.strip() for s in shards.split(",") if s.strip()]
     address = os.getenv("ORION_DB_ADDRESS")
     if address:
         if db_type in ("network", "netdb"):

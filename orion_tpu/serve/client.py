@@ -51,9 +51,10 @@ from orion_tpu.serve.protocol import (
     dumps_line,
     read_line,
 )
+from orion_tpu.storage.netdb import perform_client_handshake
 from orion_tpu.storage.retry import MODE_ALWAYS, create_retry_policy
 from orion_tpu.telemetry import TELEMETRY
-from orion_tpu.utils.exceptions import DatabaseError
+from orion_tpu.utils.exceptions import AuthenticationError, DatabaseError
 
 log = logging.getLogger(__name__)
 
@@ -87,12 +88,16 @@ class GatewayClient:
 
     def __init__(
         self, host="127.0.0.1", port=8777, timeout=60.0, idle_probe=1.0,
-        retry=None,
+        retry=None, secret=None,
     ):
         self.host = host
         self.port = int(port)
         self.timeout = timeout
         self.idle_probe = idle_probe
+        #: Shared secret for the mutual HMAC handshake (netdb discipline:
+        #: runs on every fresh connection, reconnects redo it; a no-auth
+        #: gateway is refused when a secret is configured — no downgrade).
+        self.secret = secret
         if retry is None:
             retry = {"max_attempts": 8, "deadline": 60.0, "base_delay": 0.05}
         self._policy = create_retry_policy(retry)
@@ -123,6 +128,24 @@ class GatewayClient:
         self._sock = sock
         self._file = sock.makefile("rb")
         self._last_used = time.monotonic()
+        if self.secret is not None:
+            try:
+                perform_client_handshake(
+                    self._handshake_exchange, self.secret,
+                    f"{self.host}:{self.port}",
+                )
+            except AuthenticationError:
+                self._close()
+                raise
+
+    def _handshake_exchange(self, payload):
+        """One raw request/response for the handshake (pre-protocol: no
+        retry, no translation — a torn line is a dead connection)."""
+        self._sock.sendall(payload)
+        response = read_line(self._file)
+        if response is None:
+            raise ConnectionError("gateway closed the connection")
+        return response
 
     def _close(self):
         TSAN.write("GatewayClient._conn", self)
@@ -223,6 +246,10 @@ class GatewayClient:
             )
         if error == "UnknownTenant":
             raise UnknownTenantError(message)
+        if error == "AuthenticationError":
+            # Fatal to the retry policy — re-sending the same credentials
+            # can only repeat the refusal.
+            raise AuthenticationError(message)
         raise GatewayError(f"{error}: {message}")
 
     def request(self, op, payload=None, mode=MODE_ALWAYS):
@@ -517,14 +544,21 @@ def connect_remote_algorithm(
 ):
     """Build a :class:`RemoteAlgorithm` from a ``serve:`` config section
     ({"address": "host:port", "retry": {...}, "quotas": {...}, "timeout":
-    s}) and attach it eagerly so a bad address fails at instantiation, not
-    mid-hunt."""
+    s, "secret"/"secret_file": ...}) and attach it eagerly so a bad
+    address (or refused credential) fails at instantiation, not
+    mid-hunt.  The ORION_SERVE_SECRET / ORION_SERVE_SECRET_FILE env vars
+    carry the secret when the config omits it."""
+    from orion_tpu.storage.base import resolve_wire_secret
+
     host, port = parse_address(serve_config.get("address", "127.0.0.1:8777"))
     client = GatewayClient(
         host=host,
         port=port,
         timeout=float(serve_config.get("timeout", 60.0)),
         retry=serve_config.get("retry"),
+        secret=resolve_wire_secret(
+            serve_config, env_prefix="ORION_SERVE", what="serve gateway"
+        ),
     )
     algo = RemoteAlgorithm(
         space,

@@ -65,6 +65,7 @@ from orion_tpu.serve.protocol import (
 )
 from orion_tpu.space.dsl import build_space
 from orion_tpu.storage.backends import atomic_pickle_dump
+from orion_tpu.storage.netdb import ServerHandshake, _derive_key
 from orion_tpu.telemetry import TELEMETRY, TraceContext
 
 log = logging.getLogger(__name__)
@@ -173,6 +174,11 @@ _CLOSE = object()
 
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
+        # Per-connection mutual-HMAC handshake state — the SAME
+        # PBKDF2/HMAC-SHA256 challenge-response the netdb wire runs
+        # (storage/netdb.py), so the two surfaces cannot drift on the
+        # credential contract.  ping stays open for health probes.
+        auth = ServerHandshake(self.server.auth_key)
         while True:
             try:
                 request = read_line(self.rfile)
@@ -183,10 +189,23 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             if request is None:
                 return
-            reply = self.server.handle_request(request)
+            op = request.get("op")
+            if op in ServerHandshake.AUTH_OPS:
+                reply = auth.step(request)
+            elif not auth.authenticated and op != "ping":
+                reply = error_reply(
+                    "AuthenticationError",
+                    "authentication required (gateway started with a secret)",
+                )
+            else:
+                reply = self.server.handle_request(request)
             if reply is _CLOSE:
                 return
             self.wfile.write(dumps_line(reply))
+            if auth.hangup:
+                # Failed credential check: force a reconnect (and a fresh
+                # nonce) per guess — brute force pays a TCP handshake each.
+                return
 
 
 class GatewayServer(socketserver.ThreadingTCPServer):
@@ -220,7 +239,13 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         persist=None,
         persist_interval=5.0,
         metrics_port=None,
+        secret=None,
     ):
+        # Shared-secret authentication, reusing the netdb wire's PBKDF2
+        # key stretch + mutual HMAC handshake.  None = open gateway
+        # (localhost development, --no-auth).
+        self.secret = secret
+        self.auth_key = _derive_key(secret) if secret is not None else None
         self.window = float(window)
         self.max_width = max(1, int(max_width))
         self.max_tenants = int(max_tenants)

@@ -10,6 +10,9 @@ is to this framework (see SURVEY.md §2.3/§5): all inter-worker communication
   node (reference PickledDB); the default.
 - ``network`` — TCP client to an `orion-tpu db serve` server, multi-NODE
   safe over DCN (reference MongoDB driver; see ``orion_tpu.storage.netdb``).
+- ``network`` with a ``shards:`` stanza — the consistent-hash router over
+  N netdb shards with read replicas (``orion_tpu.storage.shard``; the
+  scale-out control plane, docs/multi_node.md).
 
 Intra-suggest parallelism (on-device vmap/shard_map over a TPU mesh) is a
 *different* layer — see ``orion_tpu.parallel``.
@@ -29,6 +32,7 @@ from orion_tpu.storage.backends import PickledDB
 from orion_tpu.storage.faults import FaultProxy, FaultSchedule, FaultyDB
 from orion_tpu.storage.netdb import DBServer, NetworkDB
 from orion_tpu.storage.retry import RetryPolicy, is_transient
+from orion_tpu.storage.shard import HashRing, ShardedNetworkDB
 
 __all__ = [
     "AuditReport",
@@ -38,11 +42,13 @@ __all__ = [
     "FaultProxy",
     "FaultSchedule",
     "FaultyDB",
+    "HashRing",
     "MemoryDB",
     "NetworkDB",
     "PickledDB",
     "ReadOnlyStorage",
     "RetryPolicy",
+    "ShardedNetworkDB",
     "audit_experiment",
     "audit_storage",
     "create_storage",

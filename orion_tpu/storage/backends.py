@@ -137,6 +137,16 @@ class PickledDB:
         with self._locked() as db:
             return db.apply_batch(ops)
 
+    def collection_names(self):
+        """Enumeration surface shared by every backend (replication
+        snapshots, `db dump`): one lock/load cycle over the inner store."""
+        with self._locked(write=False) as db:
+            return db.collection_names()
+
+    def index_specs(self):
+        with self._locked(write=False) as db:
+            return db.index_specs()
+
     def read(self, collection, query=None, projection=None):
         with self._locked(write=False) as db:
             return db.read(collection, query, projection)

@@ -181,11 +181,23 @@ _BACKEND_LABELS = {
     "PickledDB": "pickled",
     "SQLiteDB": "sqlite",
     "NetworkDB": "network",
+    "ShardedNetworkDB": "shard",
 }
 
 #: Backend-maintained monotonic counters re-exported through the telemetry
-#: registry (sampled at snapshot time — zero hot-path cost).
-_BACKEND_COUNTER_ATTRS = ("txn_count", "wire_requests", "round_trips", "reconnects")
+#: registry (sampled at snapshot time — zero hot-path cost).  The sharded
+#: router adds its read-path health counters (failovers to a primary,
+#: stale replica reads, cross-shard fan-outs); other backends simply lack
+#: the attributes and skip them.
+_BACKEND_COUNTER_ATTRS = (
+    "txn_count",
+    "wire_requests",
+    "round_trips",
+    "reconnects",
+    "failovers",
+    "replica_stale_reads",
+    "fan_outs",
+)
 
 
 def _traced(op, span_name=None, retry=MODE_ALWAYS):
@@ -1031,28 +1043,35 @@ def _parse_network_address(config):
     return host, int(port)
 
 
-def _resolve_network_secret(config):
-    """Shared secret for the network driver: explicit config value, a
-    secret file (config or ORION_DB_SECRET_FILE), or ORION_DB_SECRET.
-    None = unauthenticated client (open/localhost servers)."""
+def resolve_wire_secret(config, env_prefix="ORION_DB", what="network DB"):
+    """Shared secret for an authenticated wire surface: explicit config
+    value, a secret file (config or ``{env_prefix}_SECRET_FILE``), or
+    ``{env_prefix}_SECRET``.  None = unauthenticated client (open/
+    localhost servers).  Shared by the netdb driver (``ORION_DB``) and
+    the suggest gateway client (``ORION_SERVE``) so the two wire planes
+    resolve credentials identically."""
     import os
 
     if config.get("secret") is not None:
         return str(config["secret"])
-    path = config.get("secret_file") or os.getenv("ORION_DB_SECRET_FILE")
+    path = config.get("secret_file") or os.getenv(f"{env_prefix}_SECRET_FILE")
     if path:
         try:
             with open(path) as handle:
                 secret = handle.read().strip()
         except OSError as exc:
             raise DatabaseError(
-                f"cannot read network DB secret file {path!r}: {exc} "
+                f"cannot read {what} secret file {path!r}: {exc} "
                 "(is the shared mount available on this node?)"
             ) from exc
         if not secret:
-            raise DatabaseError(f"network DB secret file {path!r} is empty")
+            raise DatabaseError(f"{what} secret file {path!r} is empty")
         return secret
-    return os.getenv("ORION_DB_SECRET") or None
+    return os.getenv(f"{env_prefix}_SECRET") or None
+
+
+def _resolve_network_secret(config):
+    return resolve_wire_secret(config, env_prefix="ORION_DB", what="network DB")
 
 
 def create_storage(config=None):
@@ -1086,13 +1105,33 @@ def create_storage(config=None):
     if db_type in ("network", "netdb"):
         from orion_tpu.storage.netdb import NetworkDB
 
+        secret = _resolve_network_secret(config)
+        if config.get("shards"):
+            # Scale-out control plane: a `shards:` stanza routes this
+            # storage through the consistent-hash router (per-shard
+            # replicas ride inside each entry; docs/multi_node.md).
+            from orion_tpu.storage.shard import ShardedNetworkDB
+
+            return DocumentStorage(
+                ShardedNetworkDB(
+                    config["shards"],
+                    vnodes=config.get("vnodes", 64),
+                    timeout=config.get("timeout", 60.0),
+                    secret=secret,
+                    reconnect_jitter=config.get("reconnect_jitter", 0.1),
+                    shard_retry=config.get("shard_retry"),
+                    replica_reads=config.get("replica_reads", True),
+                ),
+                retry=retry,
+            )
         host, port = _parse_network_address(config)
         return DocumentStorage(
             NetworkDB(
                 host=host,
                 port=port,
                 timeout=config.get("timeout", 60.0),
-                secret=_resolve_network_secret(config),
+                secret=secret,
+                reconnect_jitter=config.get("reconnect_jitter", 0.1),
             ),
             retry=retry,
         )

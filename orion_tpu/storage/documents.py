@@ -506,6 +506,24 @@ class MemoryDB:
             self._collections[name] = Collection()
         return self._collections[name]
 
+    def collection_names(self):
+        """Every collection this store holds — the enumeration surface the
+        netdb replication snapshot and `db dump` walk (every backend offers
+        it so full-state transfer never needs backend-specific probing)."""
+        with self._lock:
+            return sorted(self._collections)
+
+    def index_specs(self):
+        """``[(collection, [field, ...], unique), ...]`` for every declared
+        index — the shape ``ensure_index`` accepts, so a snapshot resync
+        can rebuild the index layout verbatim."""
+        with self._lock:
+            out = []
+            for name in sorted(self._collections):
+                for fields, unique in self._collections[name]._indexes.values():
+                    out.append((name, list(fields), unique))
+            return out
+
     # AbstractDB-style contract (reference `database/__init__.py:23-264`)
     def ensure_index(self, collection, keys, unique=False):
         with self._lock:

@@ -161,7 +161,12 @@ class FaultyDB:
         return dict(self.schedule.injected)
 
     def __getattr__(self, name):
-        target = getattr(self._inner, name)  # AttributeError propagates
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            # Mid-unpickle (or a half-built instance) has no __dict__ yet;
+            # recursing through self._inner here is a stack overflow.
+            raise AttributeError(name)
+        target = getattr(inner, name)  # AttributeError propagates
         if name in FAULTABLE_OPS:
             return self._wrap_op(name, target)
         if name in BATCH_OPS:
@@ -247,6 +252,9 @@ class _ProxyConnection:
                 data = self.client.recv(65536)
                 if not data:
                     break
+                if proxy.capture:
+                    with proxy._lock:
+                        proxy.captured_up.extend(data)
                 mode = proxy._take_mode()
                 if mode == "drop_request":
                     # Nothing reaches the server: the never-applied case.
@@ -329,6 +337,16 @@ class FaultProxy:
         self.blackhole = False
         self.connections_accepted = 0
         self.connections_dropped = 0
+        #: Wall-clock of every accepted connection (monotonic): the
+        #: reconnect-herd tests assert the SPREAD of these after a
+        #: drop_all() — lockstep re-handshakes all land within one jitter
+        #: window, spread ones don't.
+        self.accept_times = []
+        #: When True, every client->upstream byte is appended to
+        #: ``captured_up`` (across connections, in order): the router
+        #: pass-through differential compares these byte streams.
+        self.capture = False
+        self.captured_up = bytearray()
         self.faults_fired = {}
         self._mode = None
         self._lock = threading.Lock()
@@ -368,6 +386,7 @@ class FaultProxy:
             with self._lock:
                 self._conns.add(conn)
                 self.connections_accepted += 1
+                self.accept_times.append(time.monotonic())
             conn.start()
 
     def stop(self):

@@ -30,11 +30,13 @@ import json
 import logging
 import os
 import pickle
+import random
 import secrets as _secrets
 import socket
 import socketserver
 import threading
 import time
+from collections import deque
 
 from orion_tpu.health import FLIGHT
 from orion_tpu.storage.backends import atomic_pickle_dump
@@ -86,6 +88,17 @@ _MUTATING_OPS = frozenset(
     {"write", "read_and_write", "remove", "ensure_index", "ensure_indexes",
      "drop_index"}
 )
+
+# Server-level ops outside the document contract: the replication stream a
+# primary pushes to its read replicas, and the applied-sequence probe the
+# pushers (and operators) use to measure replica lag.  Both require
+# authentication — the replication stream is a full write channel.
+_SERVER_OPS = frozenset({"replicate", "seq"})
+
+#: Bounded primary-side replication log (ops, not bytes).  A replica that
+#: falls further behind than this gets a full snapshot resync instead of an
+#: op replay — the log is a fast path, never the source of truth.
+REPL_LOG_CAP = 4096
 
 
 class _JSONEncoder(json.JSONEncoder):
@@ -159,43 +172,40 @@ def _encode_outcome(result):
     return out
 
 
-class _Handler(socketserver.StreamRequestHandler):
-    def handle(self):
-        db = self.server.db
-        # No server secret -> open server (localhost dev, --no-auth).
-        self._authenticated = self.server.secret is None
-        self._auth_nonce = None
-        self._hangup = False
-        while True:
-            try:
-                request = _read_line(self.rfile)
-            except (json.JSONDecodeError, OSError) as exc:
-                log.warning("bad request from %s: %s", self.client_address, exc)
-                return
-            if request is None:
-                return
-            self.wfile.write(_dumps(self._dispatch(db, request)))
-            if self._hangup:
-                # Failed credential check: force a reconnect (and a fresh
-                # nonce) per guess, so brute force pays a TCP handshake each.
-                return
+class ServerHandshake:
+    """Server side of the two-step mutual handshake, CLIENT proves first:
+    hello -> nonces, auth -> client proof, verified before the server's own
+    proof is released.  Handing out a server MAC pre-verification would give
+    any port-scanner a free chosen-nonce sample to brute-force offline.
 
-    def _auth_dispatch(self, request):
-        """Two-step mutual handshake, CLIENT proves first: hello -> nonces,
-        auth -> client proof, verified before the server's own proof is
-        released.  Handing out a server MAC pre-verification would give any
-        port-scanner a free chosen-nonce sample to brute-force offline."""
+    Extracted so BOTH wire surfaces authenticate identically — the netdb
+    handler below and the suggest gateway (``serve/gateway.py``) each hold
+    one per connection; ``hangup`` tells the owner to drop the connection
+    after a failed credential check (a fresh nonce per guess, so brute
+    force pays a TCP handshake each)."""
+
+    AUTH_OPS = frozenset({"auth_hello", "auth"})
+
+    def __init__(self, auth_key):
+        self.auth_key = auth_key
+        # No server secret -> open server (localhost dev, --no-auth).
+        self.authenticated = auth_key is None
+        self.hangup = False
+        self._nonce = None
+        self._client_nonce = ""
+
+    def step(self, request):
         op = request["op"]
-        key = self.server.auth_key
+        key = self.auth_key
         if op == "auth_hello":
             if key is None:
                 return {"ok": True, "result": {"nonce": None}}
-            self._auth_client_nonce = str(request.get("nonce", ""))
-            self._auth_nonce = _secrets.token_hex(32)
-            return {"ok": True, "result": {"nonce": self._auth_nonce}}
+            self._client_nonce = str(request.get("nonce", ""))
+            self._nonce = _secrets.token_hex(32)
+            return {"ok": True, "result": {"nonce": self._nonce}}
         # op == "auth"
-        nonce, self._auth_nonce = self._auth_nonce, None  # one-shot
-        client_nonce = getattr(self, "_auth_client_nonce", "")
+        nonce, self._nonce = self._nonce, None  # one-shot
+        client_nonce = self._client_nonce
         expected = (
             None
             if (key is None or nonce is None)
@@ -204,7 +214,7 @@ class _Handler(socketserver.StreamRequestHandler):
         if expected is not None and hmac.compare_digest(
             str(request.get("mac", "")), expected
         ):
-            self._authenticated = True
+            self.authenticated = True
             return {
                 "ok": True,
                 "result": {
@@ -215,29 +225,93 @@ class _Handler(socketserver.StreamRequestHandler):
                     "server_mac": _mac(key, "server", client_nonce, nonce),
                 },
             }
-        self._hangup = True
+        self.hangup = True
         return {
             "ok": False,
             "error": "AuthenticationError",
             "message": "bad credentials (wrong or missing shared secret)",
         }
 
+
+def perform_client_handshake(exchange, secret, peer):
+    """Client side of the mutual handshake on a FRESH connection.
+
+    ``exchange`` is a callable taking one encoded request line and
+    returning the decoded response dict; ``peer`` labels error messages
+    (``host:port``).  Shared by :class:`NetworkDB` and the gateway client
+    (``serve/client.py``) so the downgrade/impostor refusals cannot drift
+    between the two wire surfaces.  Raises :class:`AuthenticationError`;
+    the caller closes its connection."""
+    key = _derive_key(secret)
+    client_nonce = _secrets.token_hex(16)
+    hello = exchange(_dumps({"op": "auth_hello", "nonce": client_nonce}))
+    result = hello.get("result") or {}
+    nonce = result.get("nonce")
+    if nonce is None:
+        # This client was configured with a secret; silently proceeding
+        # against a server that refuses to authenticate would hand every
+        # read AND write to whoever answered on this address (DNS/IP
+        # hijack, typoed port).  No downgrade.
+        raise AuthenticationError(
+            f"server {peer} does not require authentication, but this "
+            "client is configured with a secret — refusing to proceed "
+            "(remove the secret only if you trust the network path)"
+        )
+    reply = exchange(
+        _dumps({"op": "auth", "mac": _mac(key, "client", client_nonce, nonce)})
+    )
+    if not reply.get("ok"):
+        raise AuthenticationError(reply.get("message", "authentication failed"))
+    server_mac = str((reply.get("result") or {}).get("server_mac", ""))
+    if not hmac.compare_digest(server_mac, _mac(key, "server", client_nonce, nonce)):
+        raise AuthenticationError(
+            f"server {peer} failed to prove knowledge of the shared secret "
+            "(impostor server, or mismatched secret files)"
+        )
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        db = self.server.db
+        self._auth = ServerHandshake(self.server.auth_key)
+        while True:
+            try:
+                request = _read_line(self.rfile)
+            except (json.JSONDecodeError, OSError) as exc:
+                log.warning("bad request from %s: %s", self.client_address, exc)
+                return
+            if request is None:
+                return
+            self.wfile.write(_dumps(self._dispatch(db, request)))
+            if self._auth.hangup:
+                return
+
     def _dispatch(self, db, request):
         op = request.get("op")
-        if op in ("auth_hello", "auth"):
-            return self._auth_dispatch(request)
-        if op not in _DB_OPS:
+        if op in ServerHandshake.AUTH_OPS:
+            return self._auth.step(request)
+        if op not in _DB_OPS and op not in _SERVER_OPS:
             return {"ok": False, "error": "DatabaseError", "message": f"bad op {op!r}"}
         if op == "ping":
             # Health checks stay open: ping reveals nothing and monitoring
             # should not need the experiment secret.
             return {"ok": True, "result": "pong"}
-        if not self._authenticated:
+        if not self._auth.authenticated:
             return {
                 "ok": False,
                 "error": "AuthenticationError",
                 "message": "authentication required (server started with a secret)",
             }
+        if op == "seq":
+            return {"ok": True, "result": self.server.seq_info()}
+        if op == "replicate":
+            try:
+                args = request.get("args") or []
+                payload = args[0] if args else None
+                return {"ok": True, "result": self.server.handle_replicate(payload)}
+            except Exception as exc:  # pragma: no cover - defensive
+                log.exception("replicate failed")
+                return _encode_outcome(exc)
         if op == "batch":
             return self._batch_dispatch(db, request)
         # Distributed tracing: a request may carry an optional `ctx` field
@@ -248,10 +322,27 @@ class _Handler(socketserver.StreamRequestHandler):
         t0, ctx = self.server.adopt_begin(request)
         try:
             method = getattr(db, op)
-            result = method(*request.get("args", []), **request.get("kwargs", {}))
+            args = request.get("args", [])
+            kwargs = request.get("kwargs", {})
             if op in _MUTATING_OPS:
+                result, seq = self.server.apply_replicated(op, args, kwargs, method)
                 self.server.persist_snapshot()
-            return {"ok": True, "result": result}
+                out = {"ok": True, "result": result}
+            else:
+                # A read replica stamps its applied replication sequence on
+                # read replies so clients can tell a fresh answer from a
+                # lagging one (the sharded router's staleness contract).
+                # Stamped BEFORE the read executes: the stamp must be a
+                # LOWER bound on the state the read observed — sampling
+                # after could stamp a pre-apply read with a post-apply
+                # sequence and launder a stale answer as fresh.  Plain
+                # servers stamp nothing — zero wire change.
+                seq = self.server.read_stamp()
+                result = method(*args, **kwargs)
+                out = {"ok": True, "result": result}
+            if seq is not None:
+                out["seq"] = seq
+            return out
         except Exception as exc:
             if not isinstance(exc, (DuplicateKeyError, KeyError)):
                 log.exception("op %s failed", op)  # pragma: no cover - defensive
@@ -296,19 +387,20 @@ class _Handler(socketserver.StreamRequestHandler):
             }
         t0, ctx = self.server.adopt_begin(request)
         try:
-            apply_batch = getattr(db, "apply_batch", None)
-            if apply_batch is not None:
-                results = apply_batch(normalized)
-            else:  # pragma: no cover - every in-tree store has apply_batch
-                results = []
-                for op, sub_args, sub_kwargs in normalized:
-                    try:
-                        results.append(getattr(db, op)(*sub_args, **sub_kwargs))
-                    except Exception as exc:
-                        results.append(exc)
-            if any(op in _MUTATING_OPS for op, _, _ in normalized):
+            mutating = any(op in _MUTATING_OPS for op, _, _ in normalized)
+            # All-read batch (the producer's fetch_update_view pair): the
+            # replica stamp is taken BEFORE the batch runs — a lower bound
+            # on the observed state, same rationale as the single-op path.
+            pre_stamp = None if mutating else self.server.read_stamp()
+            results, seq = self.server.apply_batch_replicated(db, normalized)
+            if mutating:
                 self.server.persist_snapshot()
-            return {"ok": True, "result": [_encode_outcome(r) for r in results]}
+            else:
+                seq = pre_stamp
+            out = {"ok": True, "result": [_encode_outcome(r) for r in results]}
+            if seq is not None:
+                out["seq"] = seq
+            return out
         except Exception as exc:
             # Whole-batch failure (e.g. a fault-injected mid-batch kill):
             # encode through the one shared path so markers like
@@ -321,10 +413,156 @@ class _Handler(socketserver.StreamRequestHandler):
             self.server.adopt_finish("batch", t0, ctx)
 
 
+def _parse_addr(addr):
+    """``"host:port"`` / ``(host, port)`` -> (host, int(port))."""
+    if isinstance(addr, str):
+        host, _, port = addr.rpartition(":")
+        if not host or not port:
+            raise DatabaseError(f"bad replica address {addr!r}; expected host:port")
+        return host, int(port)
+    host, port = addr
+    return host, int(port)
+
+
+class _ReplicaLink:
+    """Asynchronous primary -> replica pusher: one background thread per
+    replica streams the primary's ORDERED mutation log over the ordinary
+    wire (``replicate`` requests carrying ``[(seq, op, args, kwargs), ...]``
+    chunks); a replica that restarted empty, answered with a sequence gap,
+    or fell behind the bounded log gets a full snapshot resync.  Pushes
+    retry forever with backoff — a dead replica must never stall the
+    primary (writes are acknowledged before replication: the replica tier
+    is a read-scaling plane, not a quorum)."""
+
+    PUSH_BATCH = 256
+
+    def __init__(self, server, addr, secret=None):
+        self.server = server
+        self.host, self.port = _parse_addr(addr)
+        self.client = NetworkDB(
+            host=self.host, port=self.port, timeout=10.0, secret=secret
+        )
+        self.acked_seq = None  # unknown until the first probe
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"netdb-repl-{self.host}:{self.port}",
+            daemon=True,
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def notify(self):
+        self._wake.set()
+
+    def stop(self, flush=True):
+        """Stop pushing; ``flush`` attempts one final best-effort push so a
+        clean primary shutdown leaves reachable replicas fully caught up."""
+        if flush and not self._stopped.is_set():
+            try:
+                self._push_pending()
+            except Exception:  # replica down at shutdown: nothing owed
+                log.debug("final replica flush failed", exc_info=True)
+        self._stopped.set()
+        self._wake.set()
+        self.client.close()
+
+    #: Consecutive push failures before the pusher escalates to WARNING:
+    #: a replica riding out a restart fails a handful of times (debug
+    #: noise); a PERMANENT failure — wrong secret, wrong address — would
+    #: otherwise leave the replica tier silently empty forever.
+    WARN_AFTER_FAILURES = 10
+
+    def _run(self):
+        backoff = 0.05
+        failures = 0
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._stopped.is_set():
+                return
+            try:
+                self._push_pending()
+                backoff = 0.05
+                failures = 0
+            except Exception as exc:
+                # Usually a down/partitioned replica (transient); jittered
+                # backoff so a fleet of pushers doesn't hammer a
+                # restarting replica in lockstep.  A persistent streak is
+                # escalated: auth/config mistakes are NOT transient and
+                # must reach the operator, not the debug log.
+                failures += 1
+                TELEMETRY.count("netdb.replication.push_failures")
+                if failures % self.WARN_AFTER_FAILURES == 0:
+                    log.warning(
+                        "replica %s:%s has refused %d consecutive pushes "
+                        "(latest: %s: %s) — replication to it is STALLED",
+                        self.host, self.port, failures,
+                        type(exc).__name__, exc,
+                    )
+                else:
+                    log.debug(
+                        "replica %s:%s push failed", self.host, self.port,
+                        exc_info=True,
+                    )
+                self.acked_seq = None  # re-probe after the outage
+                self._stopped.wait(backoff * (0.5 + random.random()))
+                backoff = min(backoff * 2, 2.0)
+
+    def _push_pending(self):
+        """Drain everything the replica has not acknowledged yet."""
+        while not self._stopped.is_set():
+            if self.acked_seq is None:
+                info = self.client._call("seq")
+                self.acked_seq = int((info or {}).get("seq", 0))
+            with self.server._repl_lock:
+                entries = [
+                    list(e) for e in self.server._repl_log
+                    if e[0] > self.acked_seq
+                ]
+                behind = self.server.seq > self.acked_seq
+                covered = bool(entries) and entries[0][0] == self.acked_seq + 1
+                snapshot = None
+                if behind and not covered:
+                    # The gap fell off the bounded log (or the replica
+                    # restarted empty): full resync from a consistent
+                    # point — taken under the replication lock, so no
+                    # mutation interleaves with the dump.
+                    snapshot = self.server._snapshot_payload_locked()
+            if snapshot is not None:
+                result = self.client._call("replicate", {"snapshot": snapshot})
+                TELEMETRY.count("netdb.replication.resyncs")
+                self.acked_seq = int((result or {}).get("seq", 0))
+                continue
+            if not entries:
+                return
+            chunk = entries[: self.PUSH_BATCH]
+            result = self.client._call("replicate", {"entries": chunk}) or {}
+            TELEMETRY.count("netdb.replication.pushes")
+            self.acked_seq = int(result.get("seq", 0))
+            if result.get("resync"):
+                # The replica saw a sequence gap mid-chunk; loop back —
+                # the covered/behind check above decides replay vs resync.
+                continue
+
+
 class DBServer(socketserver.ThreadingTCPServer):
     """Serve a document DB over TCP; one request = one atomic DB operation
     (MemoryDB per-op lock, or SQLiteDB transactions in x.sqlite persist
-    mode)."""
+    mode).
+
+    **Replication** (the sharded control plane's read tier,
+    docs/multi_node.md): a primary started with ``replicate_to=[addr,...]``
+    assigns every applied mutation a monotonically increasing sequence
+    number under one lock (log order IS apply order), stamps that ``seq``
+    on the mutating reply, and streams the log to each replica from a
+    background :class:`_ReplicaLink`.  A replica (any server that receives
+    ``replicate`` ops, or one started with ``replica=True``) replays the
+    stream in order and stamps its APPLIED seq on read replies — which is
+    what lets :class:`~orion_tpu.storage.shard.ShardedNetworkDB` detect a
+    lagging replica and fail a read over to the primary.  Replication is
+    asynchronous: writes are acknowledged before they reach any replica."""
 
     allow_reuse_address = True
     daemon_threads = True
@@ -344,6 +582,8 @@ class DBServer(socketserver.ThreadingTCPServer):
         persist=None,
         persist_interval=1.0,
         secret=None,
+        replicate_to=None,
+        replica=False,
     ):
         self.persist = persist
         self.persist_interval = persist_interval
@@ -381,7 +621,32 @@ class DBServer(socketserver.ThreadingTCPServer):
             if persist and os.path.exists(persist):
                 with open(persist, "rb") as handle:
                     self.db = pickle.load(handle)
+        # Live client sockets, tracked so shutdown can force-drop them: an
+        # in-process "restart" must look like a killed process to clients
+        # and replication pushers — otherwise a handler thread keeps
+        # serving the DISCARDED store over the old connection (a zombie the
+        # soak harness's shard-restart scenarios would silently talk to).
+        self._conn_lock = threading.Lock()
+        self._open_conns = set()
+        # --- replication state (primary AND replica roles) -------------------
+        # RLock: handle_replicate applies ops through the same locked window
+        # apply_replicated uses, and a snapshot resync applies indexes via
+        # the same db surface.
+        self._repl_lock = threading.RLock()
+        self._is_replica = bool(replica)
+        self._repl_log = deque(maxlen=REPL_LOG_CAP)
+        self._repl_links = []
+        # The applied/assigned sequence survives restarts THROUGH the store
+        # itself (a meta doc): a restarted primary must keep numbering where
+        # it left off or replicas would silently discard its new mutations
+        # as already-seen, and a restarted persisted replica must report its
+        # true position so the pusher resumes (or resyncs) correctly.
+        self.seq = self._load_seq()
         super().__init__((host, port), _Handler)
+        for addr in replicate_to or ():
+            link = _ReplicaLink(self, addr, secret=secret)
+            self._repl_links.append(link)
+            link.start()
         if self._snapshotting:
             self._flusher = threading.Thread(target=self._flush_loop, daemon=True)
             self._flusher.start()
@@ -389,6 +654,217 @@ class DBServer(socketserver.ThreadingTCPServer):
     @property
     def address(self):
         return self.server_address[:2]
+
+    # --- connection tracking -------------------------------------------------
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._open_conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._open_conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_connections(self):
+        """Force-drop every live client connection (see ``_open_conns``)."""
+        with self._conn_lock:
+            doomed = list(self._open_conns)
+        for sock in doomed:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # --- replication ---------------------------------------------------------
+    def apply_replicated(self, op, args, kwargs, method):
+        """Apply one mutating op; when this server replicates, the apply and
+        its log append happen under ONE lock so the log order IS the apply
+        order (replicas replay the log and must converge on identical
+        state).  Only a SUCCESSFUL apply is logged — a refused op
+        (DuplicateKeyError) changed nothing and replaying it would at best
+        waste a wire trip.  Returns ``(result, seq_or_None)``."""
+        if not self._repl_links:
+            return method(*args, **kwargs), None
+        with self._repl_lock:
+            result = method(*args, **kwargs)
+            seq = self._log_entry_locked(op, list(args), dict(kwargs or {}))
+        self._notify_links()
+        return result, seq
+
+    def apply_batch_replicated(self, db, normalized):
+        """The batch-op sibling of :meth:`apply_replicated`: the whole batch
+        is ONE log entry (per-slot outcomes are deterministic replays of the
+        same op stream, so a slot the primary refused is refused identically
+        on the replica).  All-read batches are never logged."""
+
+        def run():
+            apply_batch = getattr(db, "apply_batch", None)
+            if apply_batch is not None:
+                return apply_batch(normalized)
+            results = []  # pragma: no cover - every in-tree store has apply_batch
+            for op, sub_args, sub_kwargs in normalized:
+                try:
+                    results.append(getattr(db, op)(*sub_args, **sub_kwargs))
+                except Exception as exc:
+                    results.append(exc)
+            return results
+
+        mutating = any(op in _MUTATING_OPS for op, _, _ in normalized)
+        if not self._repl_links or not mutating:
+            return run(), None
+        with self._repl_lock:
+            results = run()
+            seq = self._log_entry_locked(
+                "batch",
+                [[[op, list(a), dict(k)] for op, a, k in normalized]],
+                {},
+            )
+        self._notify_links()
+        return results, seq
+
+    def handle_replicate(self, payload):
+        """Apply a pusher's ``replicate`` request: an ordered entry chunk
+        (seqs at or below the applied position are dropped — resends
+        converge), or a full ``snapshot``.  A mid-chunk sequence GAP stops
+        the replay and reports ``resync`` so the pusher falls back to a
+        snapshot instead of applying out of order."""
+        payload = payload or {}
+        self._is_replica = True
+        with self._repl_lock:
+            snapshot = payload.get("snapshot")
+            if snapshot is not None:
+                self._apply_snapshot_locked(snapshot)
+                applied, resync = self.seq, False
+            else:
+                applied, resync = self.seq, False
+                for entry in payload.get("entries") or []:
+                    seq = int(entry[0])
+                    op = entry[1]
+                    args = entry[2] or []
+                    kwargs = entry[3] if len(entry) > 3 and entry[3] else {}
+                    if seq <= applied:
+                        continue  # resend of an already-applied entry
+                    if seq != applied + 1:
+                        resync = True
+                        break
+                    try:
+                        if op == "batch":
+                            normalized = [
+                                (e[0], list(e[1]), dict(e[2])) for e in args[0]
+                            ]
+                            self.apply_batch_replicated(self._meta_db, normalized)
+                        else:
+                            getattr(self._meta_db, op)(*args, **kwargs)
+                    except (DuplicateKeyError, KeyError):
+                        # The primary logged this op as a SUCCESS; a
+                        # semantic refusal here means the replica diverged
+                        # (e.g. it took direct writes).  Keep going — the
+                        # stream stays ordered — but say so loudly.
+                        log.warning(
+                            "replicated op %r refused at seq %d — replica "
+                            "state diverged from its primary", op, seq,
+                        )
+                    applied = seq
+                self.seq = applied
+                self._persist_seq_locked()
+        self.persist_snapshot()
+        return {"seq": applied, "resync": resync}
+
+    def seq_info(self):
+        """The ``seq`` wire op: applied/assigned position + role."""
+        with self._repl_lock:
+            return {"seq": self.seq, "replica": self._is_replica}
+
+    def read_stamp(self):
+        """Applied seq to stamp on read replies — replicas only (plain and
+        primary servers stamp reads with nothing; their answers are
+        authoritative by construction)."""
+        if not self._is_replica:
+            return None
+        with self._repl_lock:
+            return self.seq
+
+    def replication_status(self):
+        """Operator view: position, role, and per-replica acked lag."""
+        with self._repl_lock:
+            status = {"seq": self.seq, "replica": self._is_replica}
+        status["links"] = [
+            {
+                "address": f"{link.host}:{link.port}",
+                "acked_seq": link.acked_seq,
+            }
+            for link in self._repl_links
+        ]
+        return status
+
+    @property
+    def _meta_db(self):
+        """The UNWRAPPED store for replication bookkeeping (the seq doc,
+        resync snapshots, stream replay): a chaos harness's FaultyDB wraps
+        ``self.db`` to fault the COORDINATION protocol at the op boundary;
+        replication internals fault through the protocol ops they serve,
+        never independently — a fault injected into the seq upkeep would
+        fail a client op AFTER it durably applied without the
+        ``maybe_applied`` marking real wire losses carry."""
+        return getattr(self.db, "inner", self.db)
+
+    def _log_entry_locked(self, op, args, kwargs):
+        self.seq += 1  # lint: disable=LCK002 -- caller holds _repl_lock (_locked contract)
+        self._repl_log.append((self.seq, op, args, kwargs))
+        self._persist_seq_locked()
+        return self.seq
+
+    def _persist_seq_locked(self):
+        # The meta doc lives in the store so the sequence rides the same
+        # durability the data has (SQLite persist commits it; snapshot mode
+        # pickles it with everything else).
+        db = self._meta_db
+        if not db.write("_replmeta", {"seq": self.seq}, query={"_id": "seq"}):
+            db.write("_replmeta", {"_id": "seq", "seq": self.seq})
+
+    def _load_seq(self):
+        try:
+            docs = self._meta_db.read("_replmeta", {"_id": "seq"})
+        except Exception:  # pragma: no cover - a fresh store never raises
+            return 0
+        return int(docs[0].get("seq", 0)) if docs else 0
+
+    def _snapshot_payload_locked(self):
+        """Full-state resync payload from a consistent point (the caller
+        holds the replication lock, so no mutation interleaves with the
+        dump): every collection's raw documents plus the index specs."""
+        db = self._meta_db
+        collections = {}
+        for name in db.collection_names():
+            if name == "_replmeta":
+                continue
+            collections[name] = db.read(name, {})
+        return {
+            "seq": self.seq,
+            "collections": collections,
+            "indexes": [list(spec) for spec in db.index_specs()],
+        }
+
+    def _apply_snapshot_locked(self, snapshot):
+        db = self._meta_db
+        for name in db.collection_names():
+            db.remove(name, {})
+        for col, keys, unique in snapshot.get("indexes") or []:
+            db.ensure_index(col, keys, unique=unique)
+        for name, docs in (snapshot.get("collections") or {}).items():
+            if docs:
+                db.write(name, docs)
+        self.seq = int(snapshot.get("seq", 0))  # lint: disable=LCK002 -- caller holds _repl_lock (_locked contract)
+        self._persist_seq_locked()
+
+    def _notify_links(self):
+        for link in self._repl_links:
+            link.notify()
 
     # --- distributed-trace adoption ------------------------------------------
     def adopt_begin(self, request):
@@ -474,6 +950,10 @@ class DBServer(socketserver.ThreadingTCPServer):
             return
         self._dirty.clear()
         t0 = time.perf_counter() if TELEMETRY.enabled else None
+        # Snapshot the UNWRAPPED store: a chaos harness's FaultyDB wrapper
+        # must never be pickled into the restart image (and faults never
+        # fire on the flusher's internal dump).
+        db = self._meta_db
         with self._persist_lock:
             # Hold the DB lock while pickling: handler threads mutate the
             # collections concurrently and pickle iterating a changing dict
@@ -484,8 +964,8 @@ class DBServer(socketserver.ThreadingTCPServer):
             # op calls back into the server, so persist_lock is always the
             # outer lock.  Pinned by tests/fixtures/lint/tsan_edge_cases.py.
             # lint: disable=LCK003 -- one-directional flusher edge; persist_lock always outer
-            with self.db._lock:
-                atomic_pickle_dump(self.persist, self.db)
+            with db._lock:
+                atomic_pickle_dump(self.persist, db)
         if t0 is not None:
             # The persist span rides the server track (no parent: the
             # flusher batches many requests' dirt into one dump).  Recorded
@@ -495,9 +975,25 @@ class DBServer(socketserver.ThreadingTCPServer):
                 "netdb.persist", start=t0, track=self._span_track
             )
 
+    def serve_forever(self, *args, **kwargs):
+        # Direct callers (the blocking `serve()` entry) mark the flag too.
+        self._serving = True
+        super().serve_forever(*args, **kwargs)
+
     def shutdown(self):
         self._stop_flusher.set()
-        super().shutdown()
+        # BaseServer.shutdown() waits on a flag only serve_forever sets at
+        # exit — calling it on a server that never served deadlocks
+        # forever.  A constructed-but-never-served server still owns
+        # sockets/links worth closing below.
+        if getattr(self, "_serving", False):
+            super().shutdown()
+        self.close_connections()
+        # Replica links drain after the accept loop stops (one best-effort
+        # final push), so a clean primary shutdown leaves reachable
+        # replicas caught up.
+        for link in self._repl_links:
+            link.stop(flush=True)
         # Span flush BEFORE the final snapshot so adopted spans recorded
         # since the last gate land in the persisted image too.
         if TELEMETRY.enabled:
@@ -505,20 +1001,34 @@ class DBServer(socketserver.ThreadingTCPServer):
         self._flush_if_dirty()  # final durable snapshot
 
     def serve_background(self):
-        """Start serving on a daemon thread; returns (host, port)."""
+        """Start serving on a daemon thread; returns (host, port).  The
+        serving flag is set BEFORE the thread starts: a shutdown() racing
+        the thread's entry into serve_forever must still run the real
+        BaseServer.shutdown handshake, or the accept loop would start
+        against a server its owner already believes stopped."""
+        self._serving = True
         thread = threading.Thread(target=self.serve_forever, daemon=True)
         thread.start()
         return self.address
 
 
-def serve(host="127.0.0.1", port=8765, persist=None, secret=None):  # pragma: no cover - CLI
+def serve(host="127.0.0.1", port=8765, persist=None, secret=None,
+          replicate_to=None, replica=False):  # pragma: no cover - CLI
     """Blocking server entry point (`orion-tpu db serve`)."""
-    server = DBServer(host=host, port=port, persist=persist, secret=secret)
+    server = DBServer(
+        host=host, port=port, persist=persist, secret=secret,
+        replicate_to=replicate_to, replica=replica,
+    )
     log.info("serving orion-tpu DB on %s:%s", *server.address)
     auth = "shared-secret auth" if secret else "NO auth (open server)"
+    role = ""
+    if replicate_to:
+        role = f", replicating to {len(list(replicate_to))} replica(s)"
+    elif replica:
+        role = ", read replica"
     print(
         f"orion-tpu db server listening on "
-        f"{server.address[0]}:{server.address[1]} ({auth})"
+        f"{server.address[0]}:{server.address[1]} ({auth}{role})"
     )
     try:
         server.serve_forever()
@@ -569,17 +1079,29 @@ class NetworkDB:
 
     def __init__(
         self, host="127.0.0.1", port=8765, timeout=60.0, idle_probe=1.0,
-        secret=None,
+        secret=None, reconnect_jitter=0.1, jitter_seed=None,
     ):
         self.host = host
         self.port = int(port)
         self.timeout = timeout
         self.idle_probe = idle_probe
         self.secret = secret
+        #: Herd control: a RE-connect (never the first connect) sleeps a
+        #: full-jittered uniform draw in [0, reconnect_jitter) first, so N
+        #: workers dropped by one server restart do not re-handshake in
+        #: lockstep (op-level backoff was already jittered; the reconnect
+        #: itself was not).  ``jitter_seed`` pins the stream for tests.
+        self.reconnect_jitter = float(reconnect_jitter)
+        self._jitter_rng = random.Random(jitter_seed)
         self._lock = threading.Lock()
         self._sock = None
         self._file = None
         self._last_used = 0.0
+        #: Replication sequence stamped by the last response that carried
+        #: one (mutations answered by a replicating primary; reads answered
+        #: by a replica).  None until such a response arrives — plain
+        #: servers never stamp.  Read via :meth:`seq_snapshot`.
+        self.last_seq = None
         #: Socket send/receive cycles since construction (one per _call,
         #: one per pipeline/batch regardless of op count) — bench.py's
         #: storage breakdown reads this to prove a q-batch round costs O(1)
@@ -604,6 +1126,11 @@ class NetworkDB:
     def _connect(self):
         TSAN.write("NetworkDB._conn", self)
         self._close()
+        if self._ever_connected and self.reconnect_jitter > 0.0:
+            # Full jitter BEFORE the dial: after a drop_all()-style restart
+            # every client wakes at once, and without this spread they all
+            # hit the listener (and redo the PBKDF2 handshake) in lockstep.
+            time.sleep(self._jitter_rng.random() * self.reconnect_jitter)
         sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
         if self._ever_connected:
             self.reconnects += 1
@@ -627,39 +1154,15 @@ class NetworkDB:
     def _authenticate(self):
         """Mutual HMAC handshake on a fresh connection (reconnects redo it):
         client proves first, then verifies the server proof released with
-        the auth-ok reply."""
-        key = _derive_key(self.secret)
-        client_nonce = _secrets.token_hex(16)
-        hello = self._exchange(_dumps({"op": "auth_hello", "nonce": client_nonce}))
-        result = hello.get("result") or {}
-        nonce = result.get("nonce")
-        if nonce is None:
-            # This client was configured with a secret; silently proceeding
-            # against a server that refuses to authenticate would hand every
-            # read AND write to whoever answered on this address (DNS/IP
-            # hijack, typoed port).  No downgrade.
-            self._close()
-            raise AuthenticationError(
-                f"server {self.host}:{self.port} does not require "
-                "authentication, but this client is configured with a "
-                "secret — refusing to proceed (remove the secret only if "
-                "you trust the network path)"
+        the auth-ok reply — the shared :func:`perform_client_handshake`
+        flow both wire surfaces use."""
+        try:
+            perform_client_handshake(
+                self._exchange, self.secret, f"{self.host}:{self.port}"
             )
-        reply = self._exchange(
-            _dumps({"op": "auth", "mac": _mac(key, "client", client_nonce, nonce)})
-        )
-        if not reply.get("ok"):
+        except AuthenticationError:
             self._close()
-            raise AuthenticationError(reply.get("message", "authentication failed"))
-        server_mac = str((reply.get("result") or {}).get("server_mac", ""))
-        if not hmac.compare_digest(
-            server_mac, _mac(key, "server", client_nonce, nonce)
-        ):
-            self._close()
-            raise AuthenticationError(
-                f"server {self.host}:{self.port} failed to prove knowledge of "
-                "the shared secret (impostor server, or mismatched secret files)"
-            )
+            raise
 
     def _close(self):
         TSAN.write("NetworkDB._conn", self)
@@ -686,6 +1189,7 @@ class NetworkDB:
             "port": self.port,
             "timeout": self.timeout,
             "secret": self.secret,
+            "reconnect_jitter": self.reconnect_jitter,
         }
 
     def __setstate__(self, state):
@@ -695,7 +1199,7 @@ class NetworkDB:
     # be retried blindly: the server may have applied the request before the
     # reply was lost, and a re-send would double-apply it (a second trial
     # reserved, a spurious DuplicateKeyError on an insert that succeeded).
-    _IDEMPOTENT = frozenset({"read", "count", "index_information", "ping"})
+    _IDEMPOTENT = frozenset({"read", "count", "index_information", "ping", "seq"})
 
     def _exchange(self, payload):
         """One request/response on the current socket; raises on any break.
@@ -710,9 +1214,23 @@ class NetworkDB:
         self._last_used = time.monotonic()  # lint: disable=LCK002 -- caller holds _lock
         self.round_trips += 1  # lint: disable=LCK002 -- caller holds _lock
         self.wire_requests += 1  # lint: disable=LCK002 -- caller holds _lock
+        self._note_seq(response)  # lint: disable=LCK002 -- caller holds _lock
         if t0 is not None:
             TELEMETRY.observe("storage.network.rtt", time.perf_counter() - t0)
         return response
+
+    def _note_seq(self, response):
+        """Track the replication sequence optionally stamped on a reply
+        (see :attr:`last_seq`).  Callers hold ``_lock``."""
+        seq = response.get("seq") if isinstance(response, dict) else None
+        if seq is not None:
+            self.last_seq = int(seq)  # lint: disable=LCK002 -- caller holds _lock
+
+    def seq_snapshot(self):
+        """Thread-safe read of :attr:`last_seq` (the sharded router compares
+        a replica's read stamp against its primary's write stamp)."""
+        with self._lock:
+            return self.last_seq
 
     def _probe_idle_connection(self):
         """Ping a connection that has sat idle so a mutation never rides a
@@ -855,6 +1373,8 @@ class NetworkDB:
             self._last_used = time.monotonic()
             self.round_trips += 1
             self.wire_requests += len(ops)
+            for r in responses:
+                self._note_seq(r)
             if rtt_t0 is not None:
                 # One histogram sample per socket round trip, same as
                 # _exchange — the batch paths are the produce round's
@@ -953,6 +1473,7 @@ class NetworkDB:
                 self._last_used = time.monotonic()
                 self.round_trips += 1
                 self.wire_requests += 1
+                self._note_seq(response)
                 if rtt_t0 is not None:
                     TELEMETRY.observe(
                         "storage.network.rtt", time.perf_counter() - rtt_t0

@@ -1,0 +1,925 @@
+"""Sharded, replicated network storage: the scale-out control plane.
+
+One :class:`~orion_tpu.storage.netdb.NetworkDB` talks to one server — the
+last single point of failure in the stack.  :class:`ShardedNetworkDB` is
+an AbstractDB-contract router over N independent netdb shards, slotting
+UNDER :class:`~orion_tpu.storage.base.DocumentStorage` exactly where a
+single client would sit (Oríon's design keeps all coordination behind the
+storage protocol, so nothing above this layer changes):
+
+- **Consistent-hash routing on experiment id** (Dynamo-style ring with
+  virtual nodes for balance): every document and query that names an
+  experiment — trial registration, reservation CAS, status polls, the
+  telemetry/health channels — routes to exactly one shard, so the hot
+  paths cost what they cost today regardless of shard count.  Experiment
+  ids are deterministic hashes of the experiment's unique identity
+  (``core.experiment.experiment_id``; the router mints the same way for
+  raw inserts that arrive without one), so two racing creators of the
+  same experiment land on the SAME shard and collide on its unique
+  index, exactly as they would on one server.
+- **Cross-experiment fan-out**: ops that span experiments
+  (``fetch_experiments``, fleet audits, id-only lookups that miss the
+  owner cache) run on every shard CONCURRENTLY and merge.  A fan-out leg
+  rides its shard's own :class:`~orion_tpu.storage.retry.RetryPolicy`
+  (reads only — mutations keep the op-level policy's applied-or-not
+  discipline), so one slow or dead shard never serializes the rest.
+- **Read-replica fan-out with staleness failover**: when a shard declares
+  replicas, reads (``read``/``count`` and all-read batches — the
+  ``fetch_trials``/status-poll/``fetch_health`` hot path) go to a replica
+  round-robin.  Replication is asynchronous, so every replica reply
+  carries the replica's applied sequence (``netdb.py``); the router
+  compares it against the highest sequence ITS writes ever got from that
+  shard's primary and fails the read over to the primary when the replica
+  is behind — monotonic read-your-writes per router, counted as
+  ``storage.shard.replica_stale_reads``.  Transport errors fail over too
+  (``storage.shard.failovers``) and bench the replica briefly.
+- **Degraded mode**: shards are independent connections with independent
+  retry state, so ops routed to healthy shards proceed while ops on a
+  dead shard ride the ordinary retry/deadline policy — no global stall.
+  Aggregated fan-out failures propagate the STRICTEST ``maybe_applied``
+  of their parts (:func:`merge_maybe_applied`; lint rule STO004 pins the
+  discipline).
+- **Provable pass-through**: a single-shard, no-replica config delegates
+  every op verbatim to the one underlying ``NetworkDB`` — no minting, no
+  fan-out machinery, byte-identical wire traffic (differential-pinned in
+  tests/unit/test_shard.py).
+
+The soak harness (``orion_tpu/storage/soak.py``, ``bench.py --soak``)
+drives 1000+ simulated workers against a 3-shard x 2-replica topology of
+real servers under fault-proxy partitions and shard restarts; the pass
+bar is a clean ``orion-tpu audit --all`` on every shard and zero lost
+observations.
+"""
+
+import hashlib
+import threading
+from bisect import bisect_right
+from collections import OrderedDict
+
+from orion_tpu.analysis.sanitizer import TSAN
+from orion_tpu.storage.netdb import NetworkDB
+from orion_tpu.storage.retry import MODE_ALWAYS, create_retry_policy, is_transient
+from orion_tpu.telemetry import TELEMETRY
+from orion_tpu.utils.exceptions import DatabaseError
+
+#: Virtual nodes per shard on the hash ring.  Enough that removing/adding
+#: one shard moves ~1/N of the keyspace with low variance; small enough
+#: that ring construction stays trivial.
+DEFAULT_VNODES = 64
+
+#: Bounded (collection, _id) -> shard map harvested from routed results, so
+#: id-only queries (``set_trial_status``'s CAS, ``get_trial``) route
+#: directly instead of fanning out.  A miss is never wrong — it just costs
+#: a fan-out that re-populates the entry.
+OWNER_CACHE_CAP = 65536
+
+#: Per-shard policy for fan-out READ legs: tighter than the op-level
+#: policy (which still wraps the whole op above this layer) — its job is
+#: riding out a blip on ONE shard without re-running the healthy ones.
+DEFAULT_SHARD_RETRY = {
+    "max_attempts": 3,
+    "base_delay": 0.02,
+    "max_delay": 0.25,
+    "deadline": 5.0,
+}
+
+#: Seconds a replica sits out after a transport failure before reads try
+#: it again (connection state is per shard, per replica).
+REPLICA_RETRY_S = 1.0
+
+
+def merge_maybe_applied(errors):
+    """The STRICTEST applied-or-not verdict of a fan-out's parts: if ANY
+    leg may have applied, the aggregate may have applied — anything weaker
+    would let the retry policy blind-resend a non-converging mutation one
+    shard already executed."""
+    return any(getattr(error, "maybe_applied", False) for error in errors)
+
+
+def shard_fanout_error(message, errors):
+    """The one blessed way to aggregate per-shard ``DatabaseError``s
+    (STO004): build the summary error and stamp the merged verdict."""
+    parts = "; ".join(f"{type(e).__name__}: {e}" for e in errors) or "no detail"
+    error = DatabaseError(f"{message}: {parts}")
+    error.maybe_applied = merge_maybe_applied(errors)
+    return error
+
+
+def mint_experiment_id(doc):
+    """Deterministic experiment id from the unique identity the
+    experiments collection enforces — ``(name, version, metadata.user)``
+    — computed by THE framework formula (``core.experiment
+    .experiment_id``), not a lookalike: an experiment created through the
+    builder (which pre-sets ``_id`` with that formula) and a raw
+    ``create_experiment`` for the same identity must mint the SAME id,
+    land on the SAME shard, and collide on its unique index exactly as on
+    one server.  A divergent formula would silently split one experiment
+    across two shards."""
+    from orion_tpu.core.experiment import experiment_id
+
+    return experiment_id(
+        doc.get("name"),
+        doc.get("version", 1),
+        (doc.get("metadata") or {}).get("user"),
+    )
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    Each shard contributes ``vnodes`` md5 points keyed by its stable
+    identity (the primary's ``host:port``); a key hashes once and lands on
+    the first point clockwise.  Every router instance built from the same
+    shard list computes identical placement — there is no coordination
+    channel, the ring IS the agreement.
+    """
+
+    def __init__(self, identities, vnodes=DEFAULT_VNODES):
+        if not identities:
+            raise DatabaseError("a hash ring needs at least one shard")
+        self.vnodes = int(vnodes)
+        points = []
+        for index, identity in enumerate(identities):
+            for v in range(self.vnodes):
+                points.append((self._hash(f"{identity}#{v}"), index))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._indices = [i for _, i in points]
+
+    @staticmethod
+    def _hash(key):
+        return int.from_bytes(
+            hashlib.md5(str(key).encode("utf-8")).digest()[:8], "big"
+        )
+
+    def lookup(self, key):
+        """Shard index owning ``key``."""
+        position = bisect_right(self._hashes, self._hash(key))
+        if position == len(self._hashes):
+            position = 0
+        return self._indices[position]
+
+
+def parse_shard_specs(shards, default_secret=None):
+    """Normalize a ``storage.shards`` config list into
+    ``[{"host", "port", "replicas": [(host, port), ...]}, ...]``.  Entries
+    may be ``"host:port"`` strings or dicts with ``host``/``port`` or
+    ``address`` plus an optional ``replicas`` list of the same shapes."""
+
+    def addr_of(entry):
+        if isinstance(entry, str):
+            host, _, port = entry.rpartition(":")
+            if not host or not port:
+                raise DatabaseError(
+                    f"bad shard address {entry!r}; expected host:port"
+                )
+            return host, int(port)
+        if isinstance(entry, (tuple, list)):
+            host, port = entry
+            return host, int(port)
+        host = entry.get("host", "127.0.0.1")
+        port = entry.get("port")
+        address = entry.get("address")
+        if address:
+            return addr_of(str(address))
+        if port is None:
+            raise DatabaseError(f"shard entry {entry!r} needs a port or address")
+        return host, int(port)
+
+    specs = []
+    for entry in shards or ():
+        host, port = addr_of(entry)
+        replicas = []
+        if isinstance(entry, dict):
+            replicas = [addr_of(r) for r in entry.get("replicas") or ()]
+        specs.append(
+            {
+                "host": host,
+                "port": port,
+                "replicas": replicas,
+                "secret": (
+                    entry.get("secret", default_secret)
+                    if isinstance(entry, dict)
+                    else default_secret
+                ),
+            }
+        )
+    if not specs:
+        raise DatabaseError("storage.shards is empty")
+    return specs
+
+
+class _Shard:
+    """One shard's connections + read-path state: the primary client, its
+    replica clients, the write-sequence floor replica reads are checked
+    against, and the per-shard fan-out retry policy."""
+
+    def __init__(self, index, spec, client_kwargs, policy):
+        self.index = index
+        self.host = spec["host"]
+        self.port = int(spec["port"])
+        self.primary = NetworkDB(host=self.host, port=self.port, **client_kwargs)
+        self.replicas = [
+            NetworkDB(host=h, port=p, **client_kwargs)
+            for h, p in spec.get("replicas") or ()
+        ]
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._write_floor = 0
+        self._rr = 0
+        self._down_until = [0.0] * len(self.replicas)
+        #: Read-path health counters, exported per shard as
+        #: ``storage.shard.s{i}.failovers`` / ``.replica_stale_reads``.
+        self.failovers = 0
+        self.replica_stale_reads = 0
+
+    @property
+    def identity(self):
+        return f"{self.host}:{self.port}"
+
+    @property
+    def reconnects(self):
+        return self.primary.reconnects + sum(r.reconnects for r in self.replicas)
+
+    def note_write(self):
+        """Raise the staleness floor to the primary's latest stamped seq
+        (replicating primaries stamp mutating replies; plain ones never do,
+        and the floor stays 0 = every replica read is acceptable)."""
+        seq = self.primary.seq_snapshot()
+        if seq is None:
+            return
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            if seq > self._write_floor:
+                self._write_floor = seq
+
+    def write_floor(self):
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            return self._write_floor
+
+    def pick_replica(self, now):
+        """Round-robin replica index skipping benched ones, or None."""
+        if not self.replicas:
+            return None
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            n = len(self.replicas)
+            for offset in range(n):
+                candidate = (self._rr + offset) % n
+                if self._down_until[candidate] <= now:
+                    self._rr = (candidate + 1) % n
+                    return candidate
+        return None
+
+    def bench_replica(self, index, now):
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            self._down_until[index] = now + REPLICA_RETRY_S
+            self.failovers += 1
+
+    def note_stale(self):
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            self.replica_stale_reads += 1
+
+    def close(self):
+        self.primary.close()
+        for replica in self.replicas:
+            replica.close()
+
+
+#: Query/doc values that can route: concrete scalars, never operator dicts.
+def _concrete(value):
+    if value is None or isinstance(value, (dict, list, tuple)):
+        return None
+    return value
+
+
+class ShardedNetworkDB:
+    """AbstractDB-contract consistent-hash router over N netdb shards.
+
+    See the module docstring for the full contract.  Constructed by
+    ``create_storage`` from a ``storage.shards`` config stanza; sits under
+    ``DocumentStorage`` exactly like a single ``NetworkDB``.
+    """
+
+    #: Counts and targeted reads are one small request on one shard.
+    cheap_counts = True
+
+    def __init__(
+        self,
+        shards,
+        vnodes=DEFAULT_VNODES,
+        timeout=60.0,
+        idle_probe=1.0,
+        secret=None,
+        reconnect_jitter=0.1,
+        shard_retry=None,
+        replica_reads=True,
+    ):
+        specs = parse_shard_specs(shards, default_secret=secret)
+        client_base = {
+            "timeout": timeout,
+            "idle_probe": idle_probe,
+            "reconnect_jitter": reconnect_jitter,
+        }
+        retry_config = (
+            dict(DEFAULT_SHARD_RETRY) if shard_retry is None else shard_retry
+        )
+        self._shards = []
+        for index, spec in enumerate(specs):
+            # Each shard gets its OWN policy instance: independent jitter
+            # streams and deadlines, so one shard's outage never consumes
+            # another's retry budget.
+            policy = create_retry_policy(retry_config)
+            kwargs = dict(client_base, secret=spec.get("secret"))
+            self._shards.append(_Shard(index, spec, kwargs, policy))
+        self._ring = HashRing([s.identity for s in self._shards], vnodes=vnodes)
+        self.replica_reads = bool(replica_reads)
+        #: Pure pass-through mode: one shard, no replicas — every op
+        #: delegates verbatim to the single NetworkDB (bit-identical wire
+        #: traffic; differential-pinned).
+        self._passthrough = (
+            len(self._shards) == 1 and not self._shards[0].replicas
+        )
+        self._owner_lock = threading.Lock()
+        self._owners = OrderedDict()  # (collection, _id) -> shard index
+        self._stats_lock = threading.Lock()
+        self.fan_outs = 0
+        self._monotonic = None  # injectable clock for tests
+        for shard in self._shards:
+            prefix = f"storage.shard.s{shard.index}"
+            TELEMETRY.register_external_counter(
+                f"{prefix}.reconnects", shard, "reconnects"
+            )
+            TELEMETRY.register_external_counter(
+                f"{prefix}.failovers", shard, "failovers"
+            )
+            TELEMETRY.register_external_counter(
+                f"{prefix}.replica_stale_reads", shard, "replica_stale_reads"
+            )
+
+    # --- aggregate counters (DocumentStorage re-exports these) ---------------
+    @property
+    def reconnects(self):
+        return sum(s.reconnects for s in self._shards)
+
+    @property
+    def round_trips(self):
+        return sum(
+            s.primary.round_trips + sum(r.round_trips for r in s.replicas)
+            for s in self._shards
+        )
+
+    @property
+    def wire_requests(self):
+        return sum(
+            s.primary.wire_requests + sum(r.wire_requests for r in s.replicas)
+            for s in self._shards
+        )
+
+    @property
+    def failovers(self):
+        return sum(s.failovers for s in self._shards)
+
+    @property
+    def replica_stale_reads(self):
+        return sum(s.replica_stale_reads for s in self._shards)
+
+    # --- topology surface (CLI: db ring, audit, info) ------------------------
+    @property
+    def n_shards(self):
+        return len(self._shards)
+
+    def shard_for(self, experiment_id):
+        """Ring placement of an experiment id (audit/CLI surface)."""
+        return self._ring.lookup(str(experiment_id))
+
+    def describe_topology(self):
+        return {
+            "shards": [
+                {
+                    "index": s.index,
+                    "address": s.identity,
+                    "replicas": [f"{r.host}:{r.port}" for r in s.replicas],
+                }
+                for s in self._shards
+            ],
+            "vnodes": self._ring.vnodes,
+            "replica_reads": self.replica_reads,
+        }
+
+    def shard_connections(self):
+        """``[(index, primary NetworkDB), ...]`` — the per-shard direct
+        surface the soak/audit tooling uses to verify every shard alone."""
+        return [(s.index, s.primary) for s in self._shards]
+
+    def close(self):
+        for shard in self._shards:
+            shard.close()
+
+    # --- routing core --------------------------------------------------------
+    def _now(self):
+        if self._monotonic is not None:
+            return self._monotonic()
+        import time
+
+        return time.monotonic()
+
+    def _route(self, collection, doc=None, query=None):
+        """Shard index for a doc/query, or None (fan out).  Experiments
+        route by their own ``_id``; everything else routes by the
+        ``experiment`` field, falling back to the owner cache for id-only
+        queries and to the id's own ring point for id-carrying docs."""
+        if collection == "experiments":
+            key = None
+            if query is not None:
+                key = _concrete(query.get("_id"))
+            if key is None and doc is not None:
+                key = _concrete(doc.get("_id"))
+            return None if key is None else self._ring.lookup(str(key))
+        exp = None
+        if query is not None:
+            exp = _concrete(query.get("experiment"))
+        if exp is None and doc is not None:
+            exp = _concrete(doc.get("experiment"))
+        if exp is not None:
+            return self._ring.lookup(str(exp))
+        if doc is not None:
+            _id = _concrete(doc.get("_id"))
+            if _id is not None:
+                return self._ring.lookup(str(_id))
+        if query is not None:
+            _id = _concrete(query.get("_id"))
+            if _id is not None:
+                return self._owner_of(collection, _id)
+        return None
+
+    def _owner_of(self, collection, _id):
+        with self._owner_lock:
+            TSAN.write("ShardedNetworkDB._owners", self)
+            return self._owners.get((collection, _id))
+
+    def _remember_owner(self, collection, _id, index):
+        if _id is None:
+            return
+        with self._owner_lock:
+            TSAN.write("ShardedNetworkDB._owners", self)
+            owners = self._owners
+            owners[(collection, _id)] = index
+            owners.move_to_end((collection, _id))
+            while len(owners) > OWNER_CACHE_CAP:
+                owners.popitem(last=False)
+
+    def _harvest_owners(self, collection, docs, index):
+        """Remember the shard of every id-bearing doc a routed/fanned read
+        returned, so later id-only CAS ops route directly."""
+        for doc in docs or ():
+            if isinstance(doc, dict):
+                self._remember_owner(collection, doc.get("_id"), index)
+
+    # --- fan-out machinery ---------------------------------------------------
+    def _collect_shards(self, fn, read_only=False, op="fan_out"):
+        """Run ``fn(shard)`` on every shard CONCURRENTLY; returns
+        ``(results, errors)`` as per-shard lists (exactly one of the pair
+        is non-None per slot).  Read legs ride the shard's own policy so a
+        blip on one shard heals locally; mutation legs run bare — the
+        op-level policy above owns their applied-or-not discipline."""
+        shards = self._shards
+        with self._stats_lock:
+            TSAN.write("ShardedNetworkDB._stats", self)
+            self.fan_outs += 1
+        TELEMETRY.count("storage.shard.fan_outs")
+        results = [None] * len(shards)
+        errors = [None] * len(shards)
+
+        def leg(i, shard):
+            try:
+                if read_only and shard.policy is not None:
+                    results[i] = shard.policy.run(
+                        lambda: fn(shard), op=f"shard.s{i}.{op}", mode=MODE_ALWAYS
+                    )
+                else:
+                    results[i] = fn(shard)
+            except Exception as exc:
+                errors[i] = exc
+
+        if len(shards) == 1:
+            leg(0, shards[0])
+        else:
+            threads = [
+                threading.Thread(target=leg, args=(i, shard), daemon=True)
+                for i, shard in enumerate(shards)
+            ]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join()
+        return results, errors
+
+    def _each_shard(self, fn, read_only=False, op="fan_out"):
+        """Fan out and REQUIRE every shard: aggregated failures raise with
+        the strictest ``maybe_applied`` of the parts."""
+        results, errors = self._collect_shards(fn, read_only=read_only, op=op)
+        failed = [e for e in errors if e is not None]
+        if failed:
+            raise shard_fanout_error(
+                f"{op} failed on {len(failed)}/{len(self._shards)} shard(s)",
+                failed,
+            )
+        return results
+
+    # --- replica read path ---------------------------------------------------
+    def _shard_read(self, shard, op, *args, **kwargs):
+        """One read on one shard: replica round-robin with staleness check,
+        failover to the primary on transport error or lag."""
+        if self.replica_reads and shard.replicas:
+            now = self._now()
+            index = shard.pick_replica(now)
+            if index is not None:
+                replica = shard.replicas[index]
+                try:
+                    result = getattr(replica, op)(*args, **kwargs)
+                except Exception as exc:
+                    if not is_transient(exc):
+                        raise
+                    # Dead/partitioned replica: bench it briefly and take
+                    # the primary — a failover, the first-class signal a
+                    # flapping replica tier emits.
+                    shard.bench_replica(index, now)
+                    TELEMETRY.count("storage.shard.failovers")
+                else:
+                    stamp = replica.seq_snapshot()
+                    floor = shard.write_floor()
+                    if not floor or (stamp is not None and stamp >= floor):
+                        return result
+                    # The replica answered from BEFORE this router's last
+                    # acknowledged write on the shard (the stamp check is
+                    # per connection, so a concurrent reader can only make
+                    # it stricter-to-pass, never falsely fresh for the
+                    # floor it read).  Re-read from the primary.
+                    shard.note_stale()
+                    TELEMETRY.count("storage.shard.replica_stale_reads")
+        return getattr(shard.primary, op)(*args, **kwargs)
+
+    def _shard_mutate(self, shard, op, *args, **kwargs):
+        """One mutation on one shard's PRIMARY; lifts the staleness floor
+        from the stamped reply."""
+        result = getattr(shard.primary, op)(*args, **kwargs)
+        shard.note_write()
+        return result
+
+    # --- AbstractDB contract -------------------------------------------------
+    def ping(self):
+        if self._passthrough:
+            return self._shards[0].primary.ping()
+        results = self._each_shard(
+            lambda shard: shard.primary.ping(), read_only=True, op="ping"
+        )
+        return all(results)
+
+    def ensure_index(self, collection, keys, unique=False):
+        if self._passthrough:
+            return self._shards[0].primary.ensure_index(
+                collection, keys, unique=unique
+            )
+        self._each_shard(
+            lambda shard: shard.primary.ensure_index(collection, keys, unique=unique),
+            op="ensure_index",
+        )
+
+    def ensure_indexes(self, specs):
+        if self._passthrough:
+            return self._shards[0].primary.ensure_indexes(specs)
+        specs = [list(s) for s in specs]
+        self._each_shard(
+            lambda shard: shard.primary.ensure_indexes(specs), op="ensure_indexes"
+        )
+
+    def index_information(self, collection):
+        if self._passthrough:
+            return self._shards[0].primary.index_information(collection)
+        merged = {}
+        for info in self._each_shard(
+            lambda shard: shard.primary.index_information(collection),
+            read_only=True,
+            op="index_information",
+        ):
+            merged.update(info or {})
+        return merged
+
+    def drop_index(self, collection, name):
+        if self._passthrough:
+            return self._shards[0].primary.drop_index(collection, name)
+        results, errors = self._collect_shards(
+            lambda shard: shard.primary.drop_index(collection, name),
+            op="drop_index",
+        )
+        key_errors = [e for e in errors if isinstance(e, KeyError)]
+        hard = [e for e in errors if e is not None and not isinstance(e, KeyError)]
+        if hard:
+            raise shard_fanout_error(
+                f"drop_index({collection!r}, {name!r}) failed", hard
+            )
+        if key_errors and len(key_errors) == len(self._shards):
+            # Missing EVERYWHERE is the single-server "index not found";
+            # missing somewhere is a partially-applied earlier drop that
+            # this call just finished converging.
+            raise key_errors[0]
+
+    def write(self, collection, data, query=None):
+        if self._passthrough:
+            return self._shards[0].primary.write(collection, data, query=query)
+        if query is not None:
+            index = self._route(collection, query=query)
+            if index is not None:
+                return self._shard_mutate(
+                    self._shards[index], "write", collection, data, query=query
+                )
+            results = self._each_shard(
+                lambda shard: self._shard_mutate(
+                    shard, "write", collection, data, query=query
+                ),
+                op="write",
+            )
+            return sum(r or 0 for r in results)
+        return self._insert(collection, data)
+
+    def _insert(self, collection, data):
+        single = isinstance(data, dict)
+        docs = [data] if single else list(data)
+        if collection == "experiments":
+            docs = [self._with_minted_id(doc) for doc in docs]
+        groups = OrderedDict()  # shard index -> [(position, doc)]
+        for position, doc in enumerate(docs):
+            index = self._route(collection, doc=doc)
+            if index is None:
+                # No experiment, no id: an auto-id document with no routable
+                # identity (third-party collections).  Ring-place by the
+                # collection name so placement stays deterministic.
+                index = self._ring.lookup(collection)
+            groups.setdefault(index, []).append((position, doc))
+        if single:
+            # One document, one shard: preserve the single-insert return
+            # shape (the inserted id, minted or server-assigned).
+            (index, members), = groups.items()
+            doc = members[0][1]
+            result = self._shard_mutate(self._shards[index], "write", collection, doc)
+            self._remember_owner(collection, doc.get("_id"), index)
+            return result
+        out = [None] * len(docs)
+        for index, members in groups.items():
+            payload = [doc for _, doc in members]
+            ids = self._shard_mutate(
+                self._shards[index], "write", collection, payload
+            )
+            for (position, doc), _id in zip(members, ids):
+                out[position] = _id
+                self._remember_owner(collection, doc.get("_id"), index)
+        return out
+
+    def _with_minted_id(self, doc):
+        if "_id" in doc:
+            return doc
+        doc = dict(doc)
+        doc["_id"] = mint_experiment_id(doc)
+        return doc
+
+    def update_many(self, collection, pairs):
+        if self._passthrough:
+            return self._shards[0].primary.update_many(collection, pairs)
+        routed = OrderedDict()
+        broadcast = []
+        for query, update in pairs:
+            index = self._route(collection, query=query)
+            if index is None:
+                broadcast.append((query, update))
+            else:
+                routed.setdefault(index, []).append((query, update))
+        total = 0
+        for index, shard_pairs in routed.items():
+            total += self._shard_mutate(
+                self._shards[index], "update_many", collection, shard_pairs
+            )
+        if broadcast:
+            # Un-keyed updates apply to matching docs WHEREVER they live —
+            # the correct cross-shard semantics of a query-driven update.
+            results = self._each_shard(
+                lambda shard: self._shard_mutate(
+                    shard, "update_many", collection, broadcast
+                ),
+                op="update_many",
+            )
+            total += sum(r or 0 for r in results)
+        return total
+
+    def read(self, collection, query=None, projection=None):
+        if self._passthrough:
+            return self._shards[0].primary.read(
+                collection, query=query, projection=projection
+            )
+        index = self._route(collection, query=query)
+        if index is not None:
+            docs = self._shard_read(
+                self._shards[index], "read", collection, query=query,
+                projection=projection,
+            )
+            self._harvest_owners(collection, docs, index)
+            return docs
+        merged = []
+        results = self._each_shard(
+            lambda shard: self._shard_read(
+                shard, "read", collection, query=query, projection=projection
+            ),
+            read_only=True,
+            op="read",
+        )
+        for shard, docs in zip(self._shards, results):
+            self._harvest_owners(collection, docs, shard.index)
+            merged.extend(docs or [])
+        return merged
+
+    def count(self, collection, query=None):
+        if self._passthrough:
+            return self._shards[0].primary.count(collection, query=query)
+        index = self._route(collection, query=query)
+        if index is not None:
+            return self._shard_read(
+                self._shards[index], "count", collection, query=query
+            )
+        results = self._each_shard(
+            lambda shard: self._shard_read(shard, "count", collection, query=query),
+            read_only=True,
+            op="count",
+        )
+        return sum(r or 0 for r in results)
+
+    def read_and_write(self, collection, query, data):
+        if self._passthrough:
+            return self._shards[0].primary.read_and_write(collection, query, data)
+        index = self._route(collection, query=query)
+        if index is not None:
+            doc = self._shard_mutate(
+                self._shards[index], "read_and_write", collection, query, data
+            )
+            if isinstance(doc, dict):
+                self._remember_owner(collection, doc.get("_id"), index)
+            return doc
+        if _concrete((query or {}).get("_id")) is None:
+            # A find-ONE-and-update keyed by neither _id nor experiment has
+            # no correct cross-shard spelling: running it on every shard
+            # would CAS up to N documents where one server swaps exactly
+            # one.  Refuse loudly (pre-flight: nothing ran anywhere).
+            error = DatabaseError(
+                f"read_and_write({collection!r}) query {query!r} carries "
+                "neither an _id nor an experiment key — a single-document "
+                "CAS cannot be routed (and must not run on every shard)"
+            )
+            error.maybe_applied = merge_maybe_applied(())
+            raise error
+        # Id-only owner-cache miss: ids are globally unique, so at most
+        # ONE shard matches; the others no-op to None.
+        results, errors = self._collect_shards(
+            lambda shard: shard.primary.read_and_write(collection, query, data),
+            op="read_and_write",
+        )
+        winner = None
+        for shard, doc in zip(self._shards, results):
+            if isinstance(doc, dict):
+                winner = doc
+                self._remember_owner(collection, doc.get("_id"), shard.index)
+                shard.note_write()
+        failed = [e for e in errors if e is not None]
+        if winner is not None:
+            # The unique-id invariant (the query carries a concrete _id,
+            # enforced above) means the matching shard answered; an error
+            # on a NON-matching shard cannot have applied this CAS (its
+            # query matched nothing there).
+            return winner
+        if failed:
+            raise shard_fanout_error(
+                f"read_and_write({collection!r}) failed on "
+                f"{len(failed)}/{len(self._shards)} shard(s)",
+                failed,
+            )
+        return None
+
+    def remove(self, collection, query=None):
+        if self._passthrough:
+            return self._shards[0].primary.remove(collection, query=query)
+        index = self._route(collection, query=query)
+        if index is not None:
+            return self._shard_mutate(
+                self._shards[index], "remove", collection, query=query
+            )
+        results = self._each_shard(
+            lambda shard: self._shard_mutate(shard, "remove", collection, query=query),
+            op="remove",
+        )
+        return sum(r or 0 for r in results)
+
+    # --- batch primitives ----------------------------------------------------
+    def apply_batch(self, ops):
+        if self._passthrough:
+            return self._shards[0].primary.apply_batch(ops)
+        return self._batch(ops, "apply_batch")
+
+    def pipeline(self, ops):
+        if self._passthrough:
+            return self._shards[0].primary.pipeline(ops)
+        return self._batch(ops, "pipeline")
+
+    def _route_sub_op(self, op, args, kwargs):
+        collection = args[0] if args else None
+        if op == "write":
+            data = args[1] if len(args) > 1 else None
+            query = (kwargs or {}).get("query")
+            if query is None and len(args) > 2:
+                query = args[2]
+            if query is not None:
+                return self._route(collection, query=query)
+            doc = None
+            if isinstance(data, dict):
+                doc = data
+            elif isinstance(data, (list, tuple)) and data:
+                doc = data[0] if isinstance(data[0], dict) else None
+            return self._route(collection, doc=doc)
+        query = args[1] if len(args) > 1 else (kwargs or {}).get("query")
+        if not isinstance(query, dict):
+            query = None
+        return self._route(collection, query=query)
+
+    def _batch(self, ops, primitive):
+        """Split a batch by target shard, dispatch the per-shard
+        sub-batches CONCURRENTLY through the shard's own batch primitive,
+        and reassemble per-slot outcomes in the original order.
+        Unroutable slots execute through the op-level router methods
+        (which fan out) and land their outcome — or their exception — in
+        place.  A shard whose whole sub-batch died raises the aggregated
+        error with the strictest ``maybe_applied``: healthy shards' slots
+        applied durably, and the op-level retry's re-run converges through
+        the same dedup contracts a single server's retry does."""
+        ops = list(ops)
+        if not ops:
+            return []
+        groups = OrderedDict()  # shard index -> [(position, sub_op)]
+        loose = []  # [(position, sub_op)] — unroutable
+        for position, (op, args, kwargs) in enumerate(ops):
+            index = self._route_sub_op(op, list(args), kwargs)
+            if index is None:
+                loose.append((position, (op, args, kwargs)))
+            else:
+                groups.setdefault(index, []).append((position, (op, args, kwargs)))
+        out = [None] * len(ops)
+        errors = []
+
+        def run_group(index, members):
+            shard = self._shards[index]
+            sub_ops = [sub for _, sub in members]
+            mutating = any(
+                op not in ("read", "count") for op, _, _ in sub_ops
+            )
+            try:
+                if mutating:
+                    outcomes = getattr(shard.primary, primitive)(sub_ops)
+                    shard.note_write()
+                else:
+                    outcomes = self._shard_read(shard, primitive, sub_ops)
+            except Exception as exc:
+                errors.append(exc)
+                return
+            for (position, sub), outcome in zip(members, outcomes):
+                out[position] = outcome
+                if sub[0] in ("read", "read_and_write"):
+                    docs = outcome if isinstance(outcome, list) else [outcome]
+                    self._harvest_owners(sub[1][0] if sub[1] else None, [
+                        d for d in docs if isinstance(d, dict)
+                    ], index)
+
+        if len(groups) <= 1:
+            for index, members in groups.items():
+                run_group(index, members)
+        else:
+            threads = [
+                threading.Thread(
+                    target=run_group, args=(index, members), daemon=True
+                )
+                for index, members in groups.items()
+            ]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join()
+        for position, (op, args, kwargs) in loose:
+            try:
+                out[position] = getattr(self, op)(*args, **kwargs)
+            except Exception as exc:
+                # Slot containment, same contract as a server-side refused
+                # slot: the exception IS the outcome.
+                out[position] = exc
+        if errors:
+            raise shard_fanout_error(
+                f"{primitive} failed on {len(errors)} shard(s)", errors
+            )
+        return out

@@ -226,6 +226,27 @@ class SQLiteDB:
                 (collection, row[0]),
             )
 
+    @_translate_errors
+    def collection_names(self):
+        """Every collection present in documents OR index metadata — the
+        enumeration surface the netdb replication snapshot and `db dump`
+        walk (an indexed-but-empty collection must survive a resync)."""
+        rows = self._conn().execute(
+            "SELECT DISTINCT collection FROM docs "
+            "UNION SELECT DISTINCT collection FROM idx_meta"
+        )
+        return sorted(name for (name,) in rows)
+
+    @_translate_errors
+    def index_specs(self):
+        """``[(collection, [field, ...], unique), ...]`` in the shape
+        ``ensure_index`` accepts (snapshot-resync rebuild surface)."""
+        rows = self._conn().execute(
+            "SELECT collection, fields, is_unique FROM idx_meta "
+            "ORDER BY collection, name"
+        )
+        return [(col, json.loads(fields), bool(u)) for col, fields, u in rows]
+
     def _unique_specs(self, conn, collection):
         rows = conn.execute(
             "SELECT fields FROM idx_meta WHERE collection = ? AND is_unique = 1",

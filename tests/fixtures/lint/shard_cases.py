@@ -1,0 +1,65 @@
+"""Lint fixture: sharded fan-out maybe_applied merging (STO004).
+
+Never imported — linted as source by tests/unit/test_lint_rules.py.
+Stand-ins mirror storage/shard.py's shapes: the rule matches on names
+(a ``Sharded*`` class or a ``*fan_out*`` helper, ``DatabaseError``,
+``shard_fanout_error``, ``merge_maybe_applied``), not on imports.
+"""
+
+
+class DatabaseError(Exception):
+    pass
+
+
+def merge_maybe_applied(errors):
+    return any(getattr(e, "maybe_applied", False) for e in errors)
+
+
+def shard_fanout_error(message, errors):
+    error = DatabaseError(message)
+    error.maybe_applied = merge_maybe_applied(errors)
+    return error
+
+
+class ShardedThing:
+    def good_blessed_builder(self, errors):
+        # The blessed constructor merges internally: clean.
+        raise shard_fanout_error("fan-out failed", errors)
+
+    def good_blessed_variable(self, errors):
+        error = shard_fanout_error("fan-out failed", errors)
+        raise error
+
+    def good_hand_merged(self, errors):
+        error = DatabaseError("fan-out failed")
+        error.maybe_applied = merge_maybe_applied(errors)
+        raise error
+
+    def bad_inline(self, errors):
+        # Inline constructor cannot carry the merged verdict: the summary
+        # error silently reads as safely-retriable.
+        raise DatabaseError("fan-out failed")  # expect: STO004
+
+    def bad_unmerged_variable(self, errors):
+        error = DatabaseError("fan-out failed")
+        error.maybe_applied = False  # a constant is NOT the merged verdict
+        raise error  # expect: STO004
+
+    def good_reraise_caught(self, errors):
+        # Re-raising a caught error propagates its own flag: clean.
+        try:
+            self._legs(errors)
+        except Exception as exc:
+            raise exc
+
+
+def run_fan_out(legs):
+    # Module-level fan-out helpers are in scope by NAME.
+    failures = [leg() for leg in legs]
+    raise DatabaseError("legs failed")  # expect: STO004
+
+
+def plain_helper(errors):
+    # Neither a Sharded class nor a fan-out name: out of scope, even
+    # though it raises inline (pre-flight validation raises are fine).
+    raise DatabaseError("bad arguments")

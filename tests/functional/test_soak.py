@@ -1,0 +1,143 @@
+"""Sharded control-plane soak suite (storage/soak.py).
+
+Tier-1 keeps the tiny deterministic run: 3 shards x 1 replica, a seeded
+PR-5 ``FaultSchedule`` installed server-side on every primary, and ONE
+scripted mid-run chaos action (reconnect storm + shard restart + replica
+kill) executed at a worker barrier — timing-proof, every signal
+guaranteed to fire.  The 1000-worker wall-clock soak (3 shards x 2
+replicas, periodic storms/partitions/restarts) is marked ``slow``.
+
+Pass bar everywhere: the run completes, ZERO lost observations, the
+invariant audit comes back clean through the router AND on every shard
+individually, the per-shard completed counts sum to the router's view,
+and the chaos actually registered in the counters (faults fired,
+reconnects/failovers moved).
+"""
+
+import pytest
+
+from orion_tpu.storage.faults import FaultSchedule, FaultyDB
+from orion_tpu.storage.soak import SoakTopology, drive_soak
+from orion_tpu.telemetry import TELEMETRY
+
+#: One pinned fault per round class early on, seeded extras on top — the
+#: same discipline as the single-server chaos suite (test_chaos.py).
+TINY_PLAN = {3: "error", 8: "latency", 13: "reply_lost", 17: "kill"}
+TINY_RATES = {"error": 0.02, "reply_lost": 0.01}
+
+
+@pytest.fixture
+def telemetry_enabled():
+    was = TELEMETRY.enabled
+    TELEMETRY.enable()
+    yield TELEMETRY
+    if not was:
+        TELEMETRY.disable()
+
+
+def _assert_soak_outcome(result, expect_faults=None, expect_restarts=0):
+    assert result.lost_observations == 0, result.summary()
+    assert result.completed == result.registered
+    assert result.audits_clean, result.summary()
+    # The router's completed count is exactly the sum of its shards —
+    # the two views of the same data cannot disagree.
+    assert sum(result.completed_per_shard.values()) == result.completed
+    if expect_faults is not None:
+        for schedule in expect_faults:
+            assert schedule.total_injected > 0, (
+                f"fault schedule never fired: {schedule.injected}"
+            )
+    assert result.restarts == expect_restarts
+
+
+@pytest.mark.chaos
+def test_sharded_chaos_tiny_seeded_schedule_with_restart(tmp_path,
+                                                         telemetry_enabled):
+    """Tier-1: 3 shards, seeded server-side faults on every primary, one
+    scripted shard restart + reconnect storm + replica kill at the worker
+    barrier; zero lost observations and clean audits everywhere."""
+    registry = telemetry_enabled
+    retries_before = registry.counter_value("storage.retries")
+    topo = SoakTopology(n_shards=3, replicas=1, persist_dir=str(tmp_path))
+    schedules = []
+    for shard in topo.shards:
+        schedule = FaultSchedule(
+            seed=7 + shard.index, plan=dict(TINY_PLAN), rates=TINY_RATES,
+            latency=0.005, max_faults=12,
+        )
+        schedules.append(schedule)
+        shard.install_faults(lambda db, s=schedule: FaultyDB(db, s))
+
+    def chaos_once():
+        topo.drop_all()  # reconnect storm
+        topo.shards[1].restart_primary()  # shard kill/restart (persisted)
+        for shard in topo.shards:
+            # Replica loss on EVERY shard: the read failover fires no
+            # matter where the ring placed the experiments.
+            shard.kill_replica(0)
+
+    try:
+        result = drive_soak(
+            topo, n_workers=12, n_experiments=6, trials_per_worker=4,
+            n_routers=4, chaos=False, mid_hook=chaos_once, deadline=120.0,
+        )
+    finally:
+        topo.stop()
+    _assert_soak_outcome(result, expect_faults=schedules, expect_restarts=1)
+    # The chaos signals all registered where operators would look.
+    assert result.reconnects >= 1, "the storm never forced a reconnect"
+    assert result.failovers >= 1, "the killed replica never forced a failover"
+    assert (
+        registry.counter_value("storage.retries") > retries_before
+    ), "faults fired but nothing retried — the policy is not wired in"
+
+
+@pytest.mark.chaos
+@pytest.mark.tsan
+def test_sharded_router_concurrent_workers_tsan_clean(tmp_path):
+    """The router's ring/owner/seq tables under the runtime sanitizer:
+    concurrent workers fanning out, routing, and replica-reading through
+    shared routers must produce zero data races or lock-order cycles
+    (the annotated cells are ShardedNetworkDB._owners/_shard_state/_stats)."""
+    topo = SoakTopology(n_shards=3, replicas=1, persist_dir=None)
+    try:
+        result = drive_soak(
+            topo, n_workers=8, n_experiments=4, trials_per_worker=2,
+            n_routers=2, chaos=False, deadline=60.0,
+        )
+    finally:
+        topo.stop()
+    _assert_soak_outcome(result)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_thousand_worker_soak(tmp_path, telemetry_enabled):
+    """THE headline soak: 1000 workers over 3 shards x 2 replicas under
+    periodic reconnect storms, partitions and shard restarts, plus the
+    deterministic mid-run restart/replica-kill.  Zero lost observations,
+    clean audits on every shard, failover and degraded-mode loss counted."""
+    topo = SoakTopology(n_shards=3, replicas=2, persist_dir=str(tmp_path))
+
+    def chaos_once():
+        topo.drop_all()
+        topo.shards[2].restart_primary()
+        for shard in topo.shards:
+            shard.kill_replica(0)
+
+    try:
+        result = drive_soak(
+            topo, n_workers=1000, n_experiments=24, trials_per_worker=3,
+            n_routers=32, chaos=True, chaos_period=1.0, mid_hook=chaos_once,
+            deadline=600.0,
+        )
+    finally:
+        topo.stop()
+    assert result.registered == 3000
+    _assert_soak_outcome(
+        result,
+        expect_restarts=result.restarts,  # periodic chaos may add more
+    )
+    assert result.restarts >= 1
+    assert result.reconnects >= 1
+    assert result.failovers >= 1

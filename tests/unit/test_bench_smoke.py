@@ -131,6 +131,18 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     assert serve["coalesce_max_width"] >= 2
     assert serve["dispatches_per_suggest"] < 1.0
     assert serve["audit_violations"] == 0
+    # The sharded-soak leg (storage/shard.py + soak.py): 8 workers over a
+    # real 3-shard x 1-replica netdb topology with a scripted reconnect
+    # storm, shard restart, and replica kill — bench.py hard-asserts zero
+    # lost observations, clean audits on every shard, and the chaos
+    # signals; this pins the payload schema on top.
+    soak = payload["soak"]
+    assert soak["lost_observations"] == 0
+    assert soak["audits_clean"] is True
+    assert soak["shard_restarts"] >= 1
+    assert soak["failovers"] >= 1
+    assert soak["reconnects"] >= 1
+    assert sum(soak["completed_per_shard"].values()) == soak["completed"]
     assert serve["per_tenant"] and all(
         row["p99_ms"] > 0 for row in serve["per_tenant"].values()
     )

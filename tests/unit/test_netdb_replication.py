@@ -1,0 +1,232 @@
+"""netdb primary->replica replication (storage/netdb.py).
+
+The contract the sharded read tier stands on: the primary assigns every
+applied mutation a sequence under ONE lock (log order == apply order),
+streams it asynchronously, stamps ``seq`` on mutating replies; a replica
+replays in order (resends dedup on seq), answers reads with its applied
+``seq``, and a replica that restarted empty — or fell behind the bounded
+log — converges through a full snapshot resync.  A restarted PRIMARY
+resumes its numbering from the persisted meta doc, so replicas never
+mistake its new mutations for already-seen ones.
+"""
+
+import threading
+import time
+
+import pytest
+
+from orion_tpu.storage import netdb as netdb_mod
+from orion_tpu.storage.netdb import DBServer, NetworkDB
+
+
+def _client(server, **kwargs):
+    kwargs.setdefault("reconnect_jitter", 0)
+    host, port = server.address
+    return NetworkDB(host=host, port=port, **kwargs)
+
+
+def _wait_for(predicate, timeout=8.0, message="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(message)
+
+
+@pytest.fixture
+def pair():
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    primary = DBServer(port=0, replicate_to=[replica.address])
+    primary.serve_background()
+    yield primary, replica
+    primary.shutdown()
+    primary.server_close()
+    replica.shutdown()
+    replica.server_close()
+
+
+def test_mutations_stream_in_order_and_stamp_seqs(pair):
+    primary, replica = pair
+    writer = _client(primary)
+    writer.write("trials", {"_id": "t1", "experiment": "e1", "v": 0})
+    assert writer.seq_snapshot() == 1  # replicating primary stamps writes
+    # Order matters: two updates to the same doc must land in apply order.
+    writer.write("trials", {"v": 1}, query={"_id": "t1"})
+    writer.write("trials", {"v": 2}, query={"_id": "t1"})
+    reader = _client(replica)
+    _wait_for(
+        lambda: (reader.read("trials", {"_id": "t1"}) or [{}])[0].get("v") == 2,
+        message="replica never converged to the final update",
+    )
+    # Replica reads are stamped with its applied seq.
+    assert reader.seq_snapshot() == writer.seq_snapshot() == 3
+    writer.close()
+    reader.close()
+
+
+def test_batch_replicates_as_one_entry_with_slot_semantics(pair):
+    primary, replica = pair
+    writer = _client(primary)
+    outcomes = writer.apply_batch(
+        [
+            ("write", ["trials", {"_id": "a", "experiment": "e"}], {}),
+            ("write", ["trials", {"_id": "b", "experiment": "e"}], {}),
+            ("read_and_write", ["trials", {"_id": "a"}, {"status": "x"}], {}),
+        ]
+    )
+    assert not any(isinstance(o, Exception) for o in outcomes)
+    assert writer.seq_snapshot() == 1  # the WHOLE batch is one log entry
+    reader = _client(replica)
+    _wait_for(
+        lambda: len(reader.read("trials", {"experiment": "e"})) == 2,
+        message="batch never reached the replica",
+    )
+    assert reader.read("trials", {"_id": "a"})[0]["status"] == "x"
+    writer.close()
+    reader.close()
+
+
+def test_replica_restart_converges_via_snapshot_resync(pair, tmp_path):
+    primary, replica = pair
+    writer = _client(primary)
+    for i in range(5):
+        writer.write("trials", {"_id": f"t{i}", "experiment": "e"})
+    _wait_for(lambda: replica.seq_info()["seq"] == 5)
+    # Kill the replica; restart EMPTY on the same port — its seq probe
+    # answers 0 and the pusher has the log, but the fresh store still
+    # converges (entries replay from 1) or snapshot-resyncs.
+    address = replica.address
+    replica.shutdown()
+    replica.server_close()
+    fresh = DBServer(host=address[0], port=address[1])
+    fresh.serve_background()
+    writer.write("trials", {"_id": "t9", "experiment": "e"})
+    reader = _client(fresh)
+    _wait_for(
+        lambda: len(reader.read("trials", {"experiment": "e"})) == 6,
+        message="restarted replica never converged",
+    )
+    assert fresh.seq_info()["replica"] is True  # auto-detected from the stream
+    writer.close()
+    reader.close()
+    fresh.shutdown()
+    fresh.server_close()
+
+
+def test_log_overflow_forces_snapshot_resync(monkeypatch, tmp_path):
+    """With the bounded log shrunk to 4 entries, a replica attached behind
+    by more than the log depth must converge through the snapshot path
+    (the counter-free proof: the data arrives although the needed entries
+    fell off the deque)."""
+    monkeypatch.setattr(netdb_mod, "REPL_LOG_CAP", 4)
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    # Stop the replica from hearing the early stream: point the primary at
+    # it only AFTER the log has already overflowed — simplest spelling:
+    # pause the world by writing before the link can drain.
+    primary = DBServer(port=0, replicate_to=[replica.address])
+    # NOT serving yet: the pusher runs regardless, so block it by killing
+    # the replica first.
+    address = replica.address
+    replica.shutdown()
+    replica.server_close()
+    primary.serve_background()
+    writer = _client(primary)
+    for i in range(12):  # 12 mutations >> log cap of 4
+        writer.write("trials", {"_id": f"t{i}", "experiment": "e"})
+    # Bring a fresh empty replica back on the address; the pusher's next
+    # probe sees seq 0 with a log starting at seq 9 -> snapshot resync.
+    fresh = DBServer(host=address[0], port=address[1])
+    fresh.serve_background()
+    reader = _client(fresh)
+    _wait_for(
+        lambda: len(reader.read("trials", {"experiment": "e"})) == 12,
+        message="overflowed log never snapshot-resynced",
+    )
+    assert fresh.seq_info()["seq"] == primary.seq_info()["seq"]
+    writer.close()
+    reader.close()
+    for server in (primary, fresh):
+        server.shutdown()
+        server.server_close()
+
+
+def test_primary_restart_resumes_sequence_numbering(tmp_path):
+    """A persisted primary must come back counting where it left off —
+    seq reset to 0 would make replicas silently discard every new
+    mutation as already-seen."""
+    persist = str(tmp_path / "primary.pkl")
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    primary = DBServer(
+        port=0, persist=persist, persist_interval=0.05,
+        replicate_to=[replica.address],
+    )
+    primary.serve_background()
+    port = primary.address[1]
+    writer = _client(primary)
+    for i in range(3):
+        writer.write("trials", {"_id": f"t{i}", "experiment": "e"})
+    _wait_for(lambda: replica.seq_info()["seq"] == 3)
+    writer.close()
+    primary.shutdown()
+    primary.server_close()
+    reborn = DBServer(
+        host="127.0.0.1", port=port, persist=persist,
+        replicate_to=[replica.address],
+    )
+    assert reborn.seq_info()["seq"] == 3  # restored from the meta doc
+    reborn.serve_background()
+    writer = _client(reborn)
+    writer.write("trials", {"_id": "t-after", "experiment": "e"})
+    reader = _client(replica)
+    _wait_for(
+        lambda: len(reader.read("trials", {"experiment": "e"})) == 4,
+        message="post-restart mutation never replicated",
+    )
+    writer.close()
+    reader.close()
+    for server in (reborn, replica):
+        server.shutdown()
+        server.server_close()
+
+
+def test_concurrent_writers_replicate_deterministically(pair):
+    """Many client threads hammering the primary: whatever interleaving
+    the handlers ran, the replica replays the SAME order and converges to
+    the primary's exact state."""
+    primary, replica = pair
+    clients = [_client(primary) for _ in range(4)]
+
+    def hammer(client, base):
+        for i in range(10):
+            client.write(
+                "trials", {"_id": f"w{base}-{i}", "experiment": "e"}
+            )
+            client.write("counters", {"n": base * 10 + i}, query={"_id": "c"})
+
+    threads = [
+        threading.Thread(target=hammer, args=(client, idx))
+        for idx, client in enumerate(clients)
+    ]
+    # Seed the shared counter doc first so the updates have a target.
+    clients[0].write("counters", {"_id": "c", "n": -1})
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+    reader = _client(replica)
+    _wait_for(
+        lambda: replica.seq_info()["seq"] == primary.seq_info()["seq"],
+        message="replica never caught up",
+    )
+    assert len(reader.read("trials", {"experiment": "e"})) == 40
+    # The last-applied update wins on BOTH ends identically.
+    primary_doc = _client(primary).read("counters", {"_id": "c"})[0]
+    replica_doc = reader.read("counters", {"_id": "c"})[0]
+    assert primary_doc["n"] == replica_doc["n"]
+    for client in clients:
+        client.close()
+    reader.close()
