@@ -298,7 +298,17 @@ class BaseAlgorithm:
         fidelities = None
         fid = self.space.fidelity
         if fid is not None:
-            fidelities = np.asarray([p[fid.name] for p in params_list], dtype=np.int64)
+            from orion_tpu.space.params import ParamBatch
+
+            if isinstance(params_list, ParamBatch) and params_list.has_column(
+                fid.name
+            ):
+                # Columnar fast path: the fidelity column comes straight
+                # out of the batch view — no per-trial dict probes.
+                col = params_list.column(fid.name)
+            else:
+                col = [p[fid.name] for p in params_list]
+            fidelities = np.asarray(col, dtype=np.int64)
         self.observe_arrays(cube, objectives, params_list=params_list, fidelities=fidelities)
         self._n_observed += len(params_list)
 

@@ -343,11 +343,14 @@ def default_rules():
     """Fresh instances of every registered rule family."""
     from orion_tpu.analysis.jit_rules import JIT_RULES
     from orion_tpu.analysis.lock_rules import LOCK_RULES
+    from orion_tpu.analysis.perf_rules import PERF_RULES
     from orion_tpu.analysis.storage_rules import STORAGE_RULES
     from orion_tpu.analysis.telemetry_rules import TELEMETRY_RULES
 
     rules = []
-    for family in (JIT_RULES, STORAGE_RULES, TELEMETRY_RULES, LOCK_RULES):
+    for family in (
+        JIT_RULES, STORAGE_RULES, TELEMETRY_RULES, LOCK_RULES, PERF_RULES
+    ):
         rules.extend(cls() for cls in family)
     return rules
 

@@ -1,0 +1,191 @@
+"""Hot-path vectorization rule (``PERF001``).
+
+ISSUE 13 burned the per-trial python work out of the steady-state producer
+round: the cube<->params codec runs one numpy/lookup-table pass per
+DIMENSION, trial documents build in one columnar pass, and per-trial dicts
+materialize only at the plugin-compat boundary.  Nothing in python keeps it
+that way — the natural way to write the next feature is a
+``for trial in trials:`` loop, and one q=1024 loop re-opens the exact host
+tax the refactor removed.  ``PERF001`` pins the discipline: inside the
+declared hot-path functions, a ``for`` loop or comprehension that iterates
+a batch-sized value (a q-round of trials/params/docs) is flagged.  The
+argued exceptions — per-point plugin APIs (``register_suggestion``, lie
+strategies), the storage-document edge where one doc per trial IS the
+output shape, dict-list fallbacks for pre-columnar plugins — carry
+suppressions-with-reason, which is exactly the audit trail a hot-path loop
+should leave behind.
+
+Detection is structural so fixtures (and future hot paths) participate by
+shape, not by file path: methods named in ``HOT_METHODS`` on classes named
+in ``HOT_CLASSES`` (base-class names count), plus the module-level
+functions in ``HOT_FUNCTIONS``.  A loop is batch-sized when its iterable
+resolves — through ``enumerate``/``zip``/``reversed``/slices — to one of
+the function's parameters with a batch-shaped name (``BATCH_NAMES``), or
+to a local assigned from one.
+"""
+
+import ast
+
+from orion_tpu.analysis.engine import Diagnostic, Rule, dotted_name
+
+#: Classes whose listed methods are hot-path (matched by the class's own
+#: name or any base-class name, so subclasses inherit the discipline).
+HOT_CLASSES = {
+    "Space": {
+        "arrays_to_params",
+        "params_to_arrays",
+        "params_to_cube",
+        "decode_flat_np",
+        "encode_flat_np",
+    },
+    "TrialBatch": {"prepare", "to_docs", "trials"},
+    "Producer": {
+        "_produce",
+        "_cube_rows_for",
+        "_dispatch_speculative",
+        "_take_speculative",
+    },
+    "DocumentStorage": {"register_trials", "register_trial_docs"},
+    "ParamBatch": set(),  # columnar by construction; listed for subclasses
+}
+
+#: Module-level hot-path functions, by name.
+HOT_FUNCTIONS = {"compute_batch_ids"}
+
+#: Parameter/local names that denote a q-sized batch.  Deliberately tight:
+#: the rule must stay surgical (a ``for dim in self`` per-dimension pass is
+#: the DESIRED shape and must never be flagged).
+BATCH_NAMES = frozenset(
+    {
+        "params_list",
+        "params_rows",
+        "params_batch",
+        "trials",
+        "docs",
+        "pairs",
+        "suggested",
+        "outcomes",
+        "registered_trials",
+    }
+)
+
+#: Reference twins are exempt by suffix: they exist precisely to RETAIN the
+#: per-trial loops as differential anchors.
+_REFERENCE_SUFFIX = "_reference"
+
+#: Call wrappers that preserve batch-sizedness of their first argument.
+_TRANSPARENT_CALLS = frozenset({"enumerate", "zip", "reversed", "list", "tuple"})
+
+
+class PerTrialLoopInHotPath(Rule):
+    id = "PERF001"
+    name = "per-trial-loop-in-hot-path"
+    description = (
+        "per-trial python loop (for/comprehension over a q-sized batch) "
+        "inside a producer/codec hot-path function; vectorize per-dim or "
+        "move the loop behind the plugin-compat boundary (suppress with a "
+        "reason if the boundary is argued)"
+    )
+
+    # --- hot-path discovery -------------------------------------------------
+    def _hot_functions(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                names = {node.name} | {
+                    (dotted_name(base) or "").split(".")[-1]
+                    for base in node.bases
+                }
+                methods = set()
+                for name in names:
+                    methods |= HOT_CLASSES.get(name, set())
+                if not methods:
+                    continue
+                for item in node.body:
+                    if (
+                        isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name in methods
+                        and not item.name.endswith(_REFERENCE_SUFFIX)
+                    ):
+                        yield node.name, item
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if (
+                    node.name in HOT_FUNCTIONS
+                    and not node.name.endswith(_REFERENCE_SUFFIX)
+                ):
+                    yield None, node
+
+    # --- batch-sizedness ----------------------------------------------------
+    def _batch_locals(self, fn):
+        """Parameters + locals assigned from a batch-sized expression."""
+        args = fn.args
+        names = {
+            a.arg
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+            )
+            if a.arg in BATCH_NAMES
+        }
+        # One propagation level: ``chunk = suggested[:k]`` keeps q-size.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                if self._is_batch_expr(node.value, names):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            names.add(target.id)
+        return names
+
+    def _is_batch_expr(self, node, names):
+        if isinstance(node, ast.Name):
+            return node.id in names or node.id in BATCH_NAMES
+        if isinstance(node, ast.Subscript):
+            # A slice keeps batch size; a scalar index does not.
+            if isinstance(node.slice, ast.Slice):
+                return self._is_batch_expr(node.value, names)
+            return False
+        if isinstance(node, ast.Attribute):
+            # ``batch.params`` / ``self.params`` style: the terminal
+            # attribute name carries the batch shape.
+            return node.attr in BATCH_NAMES
+        if isinstance(node, ast.Call):
+            callee = (dotted_name(node.func) or "").split(".")[-1]
+            if callee in _TRANSPARENT_CALLS and node.args:
+                return self._is_batch_expr(node.args[0], names)
+        return False
+
+    # --- check --------------------------------------------------------------
+    def check(self, module):
+        seen = set()
+        for owner, fn in self._hot_functions(module.tree):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            names = self._batch_locals(fn)
+            where = f"{owner}.{fn.name}" if owner else fn.name
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    iter_node = node.iter
+                    kind = "for loop"
+                elif isinstance(
+                    node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                           ast.GeneratorExp)
+                ):
+                    iter_node = node.generators[0].iter
+                    kind = "comprehension"
+                else:
+                    continue
+                if self._is_batch_expr(iter_node, names):
+                    yield Diagnostic(
+                        module.path,
+                        node.lineno,
+                        node.col_offset,
+                        self.id,
+                        f"per-trial {kind} over a q-sized batch in hot-path "
+                        f"'{where}'; vectorize per-dim (numpy ufunc / lookup "
+                        "table / columnar pass) or suppress with the argued "
+                        "plugin-compat reason",
+                    )
+
+
+PERF_RULES = (PerTrialLoopInHotPath,)

@@ -91,6 +91,7 @@ def load_cli_config(args):
             "max_broken": getattr(args, "max_broken", None),
             "heartbeat": getattr(args, "heartbeat", None),
             "max_idle_time": getattr(args, "max_idle_time", None),
+            "pipeline_depth": getattr(args, "pipeline_depth", None),
         }.items()
         if value is not None
     }
@@ -299,6 +300,10 @@ def build_from_args(args, need_user_args=True, allow_create=True, view=False):
     experiment.max_idle_time = float(
         config.get("max_idle_time", experiment.max_idle_time)
     )
+    # Speculative-pipeline depth rides the same worker-level channel (the
+    # Producer resolves None through ORION_TPU_PIPELINE_DEPTH to 1).
+    if config.get("pipeline_depth") is not None:
+        experiment.pipeline_depth = int(config["pipeline_depth"])
     # Suggest-gateway selection is a worker-level knob too (the same
     # experiment may run served on one box and local on another):
     # instantiate() builds a RemoteAlgorithm when this is set.

@@ -39,6 +39,15 @@ def add_subparser(subparsers):
         help="seconds the producer may go without registering a new point",
     )
     group.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=None,
+        help="speculative producer rounds kept in flight on device while "
+        "host work (storage commit, codec, telemetry) runs underneath "
+        "(default 1 = the classic single-slot pipeline; see "
+        "docs/performance.md)",
+    )
+    group.add_argument(
         "--n-workers",
         type=int,
         default=1,

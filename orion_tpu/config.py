@@ -81,6 +81,13 @@ DEFAULTS = {
     "strategy": None,
     "heartbeat": 120.0,
     "max_idle_time": 60.0,
+    # Producer speculative-pipeline depth (docs/performance.md "Wall ≈
+    # device"): how many rounds the producer keeps in flight on device
+    # while host work (storage commit, codec, telemetry flush) runs
+    # underneath.  None = unset (the ORION_TPU_PIPELINE_DEPTH env var,
+    # then the depth-1 pre-ring default, apply).  Worker-level knob, never
+    # stored experiment identity.
+    "pipeline_depth": None,
     "user_script_config": "config",
     # storage.retry holds the unified retry-policy knobs (max_attempts,
     # base_delay, max_delay, multiplier, jitter, deadline — the

@@ -29,6 +29,7 @@ DEFAULT_HEARTBEAT = 120.0
 DEFAULT_MAX_BROKEN = 3
 DEFAULT_MAX_IDLE_TIME = 60.0
 DEFAULT_POOL_SIZE = 1
+DEFAULT_PIPELINE_DEPTH = 1
 
 
 class Experiment:
@@ -45,6 +46,12 @@ class Experiment:
         self.heartbeat = config.get("heartbeat", DEFAULT_HEARTBEAT)
         self.max_idle_time = config.get("max_idle_time", DEFAULT_MAX_IDLE_TIME)
         self.pool_size = config.get("pool_size", DEFAULT_POOL_SIZE)
+        # Worker-level knob (never stored identity, like heartbeat): how many
+        # speculative rounds the producer keeps in flight (docs/performance.md
+        # "Wall ≈ device").  None = unset — the Producer resolves it through
+        # ORION_TPU_PIPELINE_DEPTH down to DEFAULT_PIPELINE_DEPTH (1, the
+        # pre-ring behavior, pinned in tests/unit/test_producer_pipeline.py).
+        self.pipeline_depth = config.get("pipeline_depth")
         self.working_dir = config.get("working_dir")
         self.algo_config = config.get("algorithms", "random")
         self.strategy_config = config.get("strategy", "MaxParallelStrategy")
@@ -204,6 +211,27 @@ class Experiment:
         if not prepared:
             self.prepare_trials(trials, parents)
         return self._storage.register_trials(trials)
+
+    def prepare_trial_batch(self, batch, parents=()):
+        """Columnar twin of :meth:`prepare_trials`: stamp a
+        :class:`~orion_tpu.core.trial.TrialBatch`'s identity fields and
+        freeze its ids WITHOUT writing storage."""
+        return batch.prepare(self._id, parents=parents)
+
+    def register_trial_batch(self, batch, parents=(), prepared=False):
+        """Columnar batch registration: the round's documents are built in
+        one pass (``TrialBatch.to_docs``) and fed straight to the storage
+        batch primitive — no per-trial ``Trial``/``to_dict`` round trips.
+        Returns per-slot outcomes (exception instances for failed slots,
+        ``DuplicateKeyError`` for an already-taken point).  Storage
+        protocols that predate ``register_trial_docs`` transparently fall
+        back to the Trial-object path (identical write sequence)."""
+        if not prepared:
+            self.prepare_trial_batch(batch, parents)
+        register_docs = getattr(self._storage, "register_trial_docs", None)
+        if register_docs is not None:
+            return register_docs(batch.to_docs())
+        return self._storage.register_trials(batch.trials())
 
     def register_lie(self, trial):
         trial.experiment = self._id

@@ -245,3 +245,144 @@ class Trial:
             f"Trial(experiment={self.experiment!r}, status={self._status!r}, "
             f"params={self.params_repr()})"
         )
+
+
+def compute_batch_ids(experiment, params_rows, lie=False):
+    """Vectorized :meth:`Trial.compute_id` over a whole q-round.
+
+    Bit-identical md5s by construction: ``repr`` of the canonical tuple is
+    assembled directly from per-part ``repr`` calls (``repr((a, [b, c], d))``
+    IS ``"(" + repr(a) + ", [" + repr(b) + ", " + repr(c) + "], " + repr(d)
+    + ")"``), with the experiment prefix, the sorted key order, and each
+    key's own ``repr`` hoisted out of the per-row work — the per-trial
+    ``sorted()`` + generator-tuple build was the single largest host cost
+    of a q=1024 registration round.  Rows whose keys differ from the first
+    row's (or are not name-sortable the way ``sorted`` on (str(k), value)
+    pairs orders them) fall back to :meth:`Trial.compute_id` — correctness
+    never depends on the fast path applying.
+
+    Pinned differentially against ``Trial.compute_id`` in
+    tests/unit/test_trial_batch.py.
+    """
+    n = len(params_rows)
+    if n == 0:
+        return []
+    first = params_rows[0]
+    keys = list(first)
+    fast = all(type(k) is str for k in keys)
+    if fast:
+        order = sorted(keys)
+        key_reprs = [repr(k) for k in order]
+        prefix = f"({str(experiment)!r}, ["
+        suffix = "], True)" if lie else "], False)"
+        key_set = frozenset(order)
+    ids = []
+    md5 = hashlib.md5
+    # lint: disable=PERF001 -- the md5 identity is per-trial by contract
+    # (it IS the storage unique index); everything row-invariant (sort
+    # order, key reprs, experiment prefix) is hoisted above, leaving one
+    # string assembly + hash per row.
+    for params in params_rows:
+        if fast and params.keys() == key_set:
+            parts = ", ".join(
+                f"({kr}, {_canonical(params[k])!r})"
+                for k, kr in zip(order, key_reprs)
+            )
+            ids.append(md5((prefix + parts + suffix).encode("utf-8")).hexdigest())
+        else:
+            ids.append(Trial.compute_id(experiment, params, lie=lie))
+    return ids
+
+
+class TrialBatch:
+    """One q-round of trials in columnar form — the storage-document edge.
+
+    Wraps the round's param rows (a lazy
+    :class:`~orion_tpu.space.params.ParamBatch` or a plain dict list) and
+    builds the q storage documents in ONE pass (:meth:`to_docs`), ids
+    included, instead of q :class:`Trial` constructions + ``to_dict``
+    round trips.  Real ``Trial`` objects exist only behind :meth:`trials`,
+    for the plugin-compat boundary (the producer's speculative
+    lie-conditioning, loop-fallback storage protocols) — they carry the
+    precomputed ids, so materializing them never re-pays the md5.
+    """
+
+    __slots__ = ("params", "experiment", "parents", "submit_time", "ids",
+                 "_trials")
+
+    def __init__(self, params):
+        self.params = params
+        self.experiment = None
+        self.parents = []
+        self.submit_time = None
+        self.ids = None
+        self._trials = None
+
+    def __len__(self):
+        return len(self.params)
+
+    def prepare(self, experiment, parents=(), submit_time=None):
+        """Stamp the identity fields and freeze the ids (the columnar twin
+        of ``Experiment.prepare_trials``): after this, callers may key
+        caches or dispatch device work against the real ids BEFORE the
+        storage commit."""
+        self.experiment = experiment
+        self.parents = list(parents)
+        self.submit_time = time.time() if submit_time is None else submit_time
+        self.ids = compute_batch_ids(experiment, self.params)
+        self._trials = None
+        return self
+
+    @property
+    def prepared(self):
+        return self.ids is not None
+
+    def to_docs(self):
+        """The q raw trial documents, key-for-key what ``Trial.to_dict``
+        emits for a freshly prepared trial — fed straight to the storage
+        batch primitive (``apply_batch``).  Backends copy/serialize on
+        write, so handing out the live param row dicts is safe."""
+        experiment = self.experiment
+        submit_time = self.submit_time
+        parents = list(self.parents)
+        # lint: disable=PERF001 -- the storage-document edge: one JSON doc
+        # per trial IS the output shape; everything inside is O(1) per row.
+        return [
+            {
+                "_id": _id,
+                "experiment": experiment,
+                "status": "new",
+                "params": params,
+                "results": [],
+                "worker": None,
+                "submit_time": submit_time,
+                "start_time": None,
+                "end_time": None,
+                "heartbeat": None,
+                "working_dir": None,
+                "parents": parents,
+            }
+            for _id, params in zip(self.ids, self.params)
+        ]
+
+    def trials(self):
+        """Materialized :class:`Trial` views (cached) — the plugin-compat
+        boundary.  Ids ride along as overrides; no md5 is recomputed."""
+        if self._trials is None:
+            ids = self.ids or [None] * len(self.params)
+            # lint: disable=PERF001 -- plugin-compat boundary: per-point
+            # Trial objects only materialize for per-point plugin APIs.
+            self._trials = [
+                Trial(
+                    experiment=self.experiment,
+                    params=params,
+                    submit_time=self.submit_time,
+                    parents=self.parents,
+                    _id=_id,
+                )
+                for _id, params in zip(ids, self.params)
+            ]
+        return self._trials
+
+    def trial_at(self, index):
+        return self.trials()[index]

@@ -899,7 +899,10 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         if batch.cube is not None:
             self._finish_suggest(job, cube=np.asarray(batch.cube)[: job.num])
         else:
-            self._finish_suggest(job, params=batch.params[: job.num])
+            # The wire boundary: replies are JSON, so a lazy ParamBatch
+            # materializes its dicts here (list() is a no-op for the
+            # host-scheduled algorithms that already produced a list).
+            self._finish_suggest(job, params=list(batch.params[: job.num]))
 
     def _book_dispatch(self, width):
         with self._lock:

@@ -398,6 +398,60 @@ class Categorical(Dimension):
             return np.asarray(flat, dtype=object).reshape(self.shape)
         return self.categories[int(arr)]
 
+    # --- vectorized column codec (the q-batch hot path) ---------------------
+    # One lookup-table pass per COLUMN instead of a python ``to_index``/
+    # ``from_index`` call per value.  Bit-identical to the per-value loops
+    # (tests/unit/test_space_codec_diff.py pins it): the index map is
+    # first-occurrence-wins like ``list.index`` (categories 1 and 1.0 are
+    # == and would otherwise collapse to the LAST entry under plain dict
+    # insertion), and the value table hands out the SAME category objects.
+
+    def _index_lut(self):
+        lut = self.__dict__.get("_index_lut_cache")
+        if lut is None:
+            lut = {}
+            for i, cat in enumerate(self.categories):
+                lut.setdefault(cat, i)  # first occurrence wins (== list.index)
+            object.__setattr__(self, "_index_lut_cache", lut)
+        return lut
+
+    def _category_array(self):
+        arr = self.__dict__.get("_category_array_cache")
+        if arr is None:
+            # np.asarray(categories) would coerce tuple/list categories
+            # into extra array dimensions; fill an object array instead.
+            arr = np.empty(len(self.categories), dtype=object)
+            arr[:] = list(self.categories)
+            object.__setattr__(self, "_category_array_cache", arr)
+        return arr
+
+    def to_index_column(self, values):
+        """Vectorized ``[to_index(v) for v in values]`` for scalar dims."""
+        lut = self._index_lut()
+        out = []
+        for value in values:
+            try:
+                out.append(lut[value])
+            except (KeyError, TypeError):
+                # Unhashable or unknown value: the reference path both
+                # resolves == matches list.index-style and raises the
+                # canonical ValueError for genuinely unknown categories.
+                out.append(self.to_index(value))
+        return out
+
+    def from_index_column(self, col):
+        """Vectorized ``[from_index(i)...]`` over an index column.
+
+        Scalar dims get a list of category objects (identical objects to
+        the per-value path); shaped dims a list of ``shape``-shaped object
+        arrays, matching ``from_index``'s row output."""
+        table = self._category_array()
+        if self.shape:
+            n = np.asarray(col).shape[0]
+            block = table[np.asarray(col, dtype=np.intp).reshape(n, -1)]
+            return [row.reshape(self.shape) for row in block]
+        return table[np.asarray(col, dtype=np.intp)].tolist()
+
     def cast(self, value):
         # Accept either a category literal or its string form.
         if value in self.categories:

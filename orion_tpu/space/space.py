@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from orion_tpu.space.dims import Categorical, Dimension, Fidelity, NotSet
+from orion_tpu.space.params import ParamBatch
 
 
 class Space:
@@ -198,16 +199,50 @@ class Space:
 
     # --- host <-> device boundary ------------------------------------------
     def arrays_to_params(self, arrays, fidelity_value=None):
-        """Device arrays -> list of structured param dicts (host).
+        """Device arrays -> :class:`~orion_tpu.space.params.ParamBatch`
+        (a lazy columnar sequence of structured param dicts).
 
         Categorical indices become category objects; a fidelity value (or the
         dim's high) is attached when the space has a fidelity dimension.
+        Columns are built eagerly in one vectorized pass per dimension
+        (clamp/cast semantics must be fixed at decode time); the per-trial
+        dicts materialize lazily at the plugin-compat boundary — the
+        steady-state producer round never builds them at all
+        (``arrays_to_params_reference`` keeps the eager loop as the pinned
+        equivalence reference).
         """
         host = {k: np.asarray(v) for k, v in arrays.items()}
         n = next(iter(host.values())).shape[0] if host else 0
-        # Columnar conversion: one vectorized pass per dimension, then zip
-        # rows into dicts — python-loop-per-value would dominate q=1024
-        # suggest calls.
+        names, columns = [], []
+        for dim in self:
+            names.append(dim.name)
+            if isinstance(dim, Fidelity):
+                fv = int(fidelity_value if fidelity_value is not None else dim.high)
+                columns.append([fv] * n)
+                continue
+            col = host[dim.name]
+            if isinstance(dim, Categorical):
+                # Lookup-table pass (dims.from_index_column): no python
+                # from_index/int() call per value.
+                columns.append(dim.from_index_column(col))
+            elif dim.shape:
+                # cast_decoded is elementwise (round-to-precision + clamp):
+                # one call over the whole (n, *shape) block, then split
+                # into the per-trial rows the dict view hands out.
+                columns.append(list(dim.cast_decoded(col)))
+            else:
+                columns.append(dim.cast_column(col))
+        return ParamBatch(names, columns)
+
+    def arrays_to_params_reference(self, arrays, fidelity_value=None):
+        """The retained pre-vectorization loop: per-value ``from_index`` /
+        ``cast_decoded`` and an eager ``dict(zip(...))`` per trial.  NOT a
+        hot path — it exists as the differential anchor
+        (tests/unit/test_space_codec_diff.py) pinning
+        :meth:`arrays_to_params` bit-identical to the original semantics.
+        """
+        host = {k: np.asarray(v) for k, v in arrays.items()}
+        n = next(iter(host.values())).shape[0] if host else 0
         names, columns = [], []
         for dim in self:
             names.append(dim.name)
@@ -229,7 +264,8 @@ class Space:
         return [dict(zip(names, row)) for row in zip(*columns)] if names else []
 
     def params_to_cube(self, params_list):
-        """List of structured param dicts -> (n, D) float32 unit-cube rows.
+        """Param dicts (list or :class:`ParamBatch`) -> (n, D) float32
+        unit-cube rows.
 
         THE canonical dict->cube pipeline (``params_to_arrays`` +
         ``encode_flat_np``), factored so every observe-side caller — the
@@ -240,10 +276,42 @@ class Space:
         """
         return self.encode_flat_np(self.params_to_arrays(params_list))
 
+    def params_to_cube_reference(self, params_list):
+        """Retained reference loop for :meth:`params_to_cube` (differential
+        anchor; see :meth:`params_to_arrays_reference`)."""
+        return self.encode_flat_np(self.params_to_arrays_reference(params_list))
+
     def params_to_arrays(self, params_list):
-        """List of structured param dicts -> dict of host numpy arrays
-        (device-ready: jnp.asarray is a cheap upload when a jitted consumer
-        wants them)."""
+        """Param dicts -> dict of host numpy arrays (device-ready:
+        jnp.asarray is a cheap upload when a jitted consumer wants them).
+
+        Columnar fast path: a :class:`ParamBatch` input hands its columns
+        over directly — zero per-trial work.  A plain list of dicts (the
+        plugin-compat boundary) pays one gather pass per dimension, with
+        categorical values resolved through the per-dim lookup table
+        (``dims.to_index_column``) instead of a ``list.index`` per value."""
+        columnar = isinstance(params_list, ParamBatch)
+        out = {}
+        for dim in self:
+            if isinstance(dim, Fidelity):
+                continue
+            if columnar and params_list.has_column(dim.name):
+                col = params_list.column(dim.name)
+            else:
+                # lint: disable=PERF001 -- plugin-compat boundary: a plain
+                # dict list has no columns to pull; one gather per dim.
+                col = [p[dim.name] for p in params_list]
+            if isinstance(dim, Categorical):
+                vals = np.asarray(dim.to_index_column(col))
+            else:
+                vals = np.asarray(col, dtype=float)
+            out[dim.name] = vals
+        return out
+
+    def params_to_arrays_reference(self, params_list):
+        """Retained pre-vectorization loop (per-value ``to_index``, one
+        comprehension per dim over the dict list) — the differential anchor
+        for :meth:`params_to_arrays`."""
         out = {}
         for dim in self:
             if isinstance(dim, Fidelity):

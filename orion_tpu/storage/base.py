@@ -485,17 +485,50 @@ class DocumentStorage(BaseStorage):
         q-batch instead of q), one wire request on the network driver, one
         lock/load/dump cycle on the pickled file."""
         now = time.time()
+        # lint: disable=PERF001 -- Trial-object compat path (plugins and
+        # direct callers hand real Trials); the producer's columnar round
+        # rides register_trial_docs below instead.
         for trial in trials:
             trial.submit_time = trial.submit_time or now
         if not self._db_batch_capable():
             return super().register_trials(trials)
         results = self._db_batch(
+            # lint: disable=PERF001 -- per-trial to_dict IS this compat
+            # path's contract; the columnar twin builds docs in one pass.
             [("write", ["trials", trial.to_dict()], {}) for trial in trials]
         )
+        # lint: disable=PERF001 -- O(1) zip per slot pairing outcomes back
+        # to their trials.
         return [
             result if isinstance(result, Exception) else trial
             for trial, result in zip(trials, results)
         ]
+
+    @_traced("register_trials", span_name="storage.commit", retry=MODE_ALWAYS)
+    def register_trial_docs(self, docs):
+        """Columnar twin of :meth:`register_trials`: RAW trial documents
+        (one columnar ``TrialBatch.to_docs`` pass upstream — no ``Trial``
+        objects, no per-trial ``to_dict``) committed as ONE backend round.
+        One outcome per doc: an exception instance for a failed slot
+        (``DuplicateKeyError`` for an already-taken point), any other value
+        means the slot registered.  Same wire/transaction shape as
+        ``register_trials`` — one ``write`` sub-op per doc through the
+        batch primitive — so crash-consistency and convergence contracts
+        (docs/robustness.md) are unchanged; shares its telemetry op name
+        (``storage.commit`` span) for dashboard continuity."""
+        if not self._db_batch_capable():
+            out = []
+            # lint: disable=PERF001 -- loop fallback for backends without
+            # a batch primitive; the hot path is the _db_batch leg below.
+            for doc in docs:
+                try:
+                    out.append(self._db.write("trials", doc))
+                except Exception as exc:
+                    out.append(exc)
+            return out
+        # lint: disable=PERF001 -- one wire/transaction sub-op per doc IS
+        # the batch primitive's slot shape (per-slot outcomes require it).
+        return self._db_batch([("write", ["trials", doc], {}) for doc in docs])
 
     @_traced("update_completed_trials", retry=MODE_ALWAYS)
     def update_completed_trials(self, pairs):

@@ -348,6 +348,16 @@ class Collection:
                     f"duplicate key on index {fields}"
                 )
 
+    def _unique_keys(self, doc):
+        """One ``_index_key`` computation per unique index, shared by the
+        duplicate check AND the index insert — ``insert`` previously paid
+        the dotted-path walk + canonicalization twice per document, which
+        is pure overhead at q-batch registration scale."""
+        return [
+            (fields, entries, self._index_key(doc, fields))
+            for fields, entries in self._unique_maps.items()
+        ]
+
     def _index_add(self, doc):
         for fields, entries in self._unique_maps.items():
             entries[self._index_key(doc, fields)] = doc["_id"]
@@ -374,12 +384,22 @@ class Collection:
         if "_id" not in doc:
             self._auto_id += 1
             doc["_id"] = self._auto_id
-        if doc["_id"] in self._docs:
-            raise DuplicateKeyError(f"duplicate _id {doc['_id']!r}")
-        self._check_unique(doc)
-        self._docs[doc["_id"]] = doc
-        self._index_add(doc)
-        return doc["_id"]
+        _id = doc["_id"]
+        if _id in self._docs:
+            raise DuplicateKeyError(f"duplicate _id {_id!r}")
+        # Compute each unique-index key ONCE, check-then-add with the same
+        # values (the q-batch register path inserts q docs back to back).
+        unique_keys = self._unique_keys(doc)
+        for fields, entries, key in unique_keys:
+            if entries.get(key) is not None:
+                raise DuplicateKeyError(f"duplicate key on index {fields}")
+        self._docs[_id] = doc
+        for _fields, entries, key in unique_keys:
+            entries[key] = _id
+        for field, entries in self._value_maps.items():
+            key = _value_map_key(_get_path(doc, field)[1])
+            entries.setdefault(key, {})[_id] = None
+        return _id
 
     def _candidates(self, query):
         """Docs possibly matching: O(1) for point queries by _id; narrowed

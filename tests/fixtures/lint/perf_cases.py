@@ -1,0 +1,68 @@
+"""PERF001 fixtures: per-trial loops in producer/codec hot-path functions.
+
+Bad shapes: for/comprehension iterating a q-sized batch (a batch-named
+parameter, or a local derived from one through enumerate/zip/slices)
+inside a declared hot-path function.  Good shapes: per-DIM loops (the
+desired vectorized form), reference twins (retained differential anchors),
+suppressions with the argued plugin-compat reason, and batch loops in
+NON-hot-path functions.
+"""
+
+
+class Space:
+    def params_to_arrays(self, params_list):
+        out = {}
+        for dim in self.dims:  # per-DIM pass: the desired shape, quiet
+            out[dim.name] = [p[dim.name] for p in params_list]  # expect: PERF001
+        return out
+
+    def params_to_arrays_reference(self, params_list):
+        # Reference twin: retained per-trial loop, exempt by suffix.
+        return [dict(p) for p in params_list]
+
+    def arrays_to_params(self, arrays, params_list=None):
+        chunk = params_list[:16]  # slicing keeps batch size
+        rows = [dict(p) for p in chunk]  # expect: PERF001
+        for i, p in enumerate(params_list):  # expect: PERF001
+            rows[i] = p
+        return rows
+
+    def helper(self, params_list):
+        # Not a hot-path method name: batch loops are this function's
+        # business (PERF001 stays surgical).
+        return [dict(p) for p in params_list]
+
+
+class TrialBatch:
+    def to_docs(self, docs=None):
+        # lint: disable=PERF001 -- the storage-document edge: one doc per
+        # trial is the output shape.
+        return [dict(d) for d in docs]
+
+    def trials(self, trials=None):
+        out = []
+        for trial in trials:  # expect: PERF001
+            out.append(trial)
+        return out
+
+
+class Producer:
+    def _produce(self, suggested, outcomes):
+        for outcome in outcomes:  # expect: PERF001
+            print(outcome)
+        batch = list(zip(suggested, outcomes))  # noqa: assigned from batch
+        return [b for b in batch]  # expect: PERF001
+
+
+def compute_batch_ids(experiment, params_rows):
+    return [hash((experiment, tuple(p))) for p in params_rows]  # expect: PERF001
+
+
+def compute_batch_ids_reference(experiment, params_rows):
+    # Reference twin, exempt.
+    return [hash((experiment, tuple(p))) for p in params_rows]
+
+
+def free_function(trials):
+    # Module-level function NOT in the hot-path set: quiet.
+    return [t for t in trials]

@@ -113,19 +113,22 @@ def test_sigkill_mid_write_leaves_store_consistent(tmp_path, backend):
             db.close()
 
 
+@pytest.mark.parametrize("pipeline_depth", [1, 3])
 @pytest.mark.parametrize("applied_before_failure", [False, True])
 def test_overlapped_commit_failure_keeps_suggest_batch_consistent(
-    applied_before_failure,
+    applied_before_failure, pipeline_depth
 ):
-    """The producer's pipelined commit dispatches the NEXT round's
-    speculative suggest before writing the current batch to storage.  A
-    storage failure inside that overlapped commit must neither lose the
-    in-flight speculative batch (it is consumed and registered by the next
-    round) nor double-register/double-observe the batch that failed.  Both
-    failure shapes are covered: the commit never reached storage, and the
-    genuinely unknowable "applied server-side but the reply was lost" case
-    (the unique index + the producer's duplicate absorption make the retry
-    converge instead of duplicating)."""
+    """The producer's pipelined commit dispatches up to ``pipeline_depth``
+    speculative rounds before writing the current batch to storage.  A
+    storage failure inside that overlapped commit must discard EVERY
+    in-flight ring entry (their conditioning presumed the failed batch
+    registered) without double-registering/double-observing the batch that
+    failed.  Both failure shapes are covered: the commit never reached
+    storage, and the genuinely unknowable "applied server-side but the
+    reply was lost" case (the unique index + the producer's duplicate
+    absorption make the retry converge instead of duplicating).  Depth 1
+    is the pre-ring behavior; depth 3 proves the same contract holds with
+    a full ring in flight."""
     from orion_tpu.core.experiment import build_experiment
     from orion_tpu.core.producer import Producer
     from orion_tpu.core.trial import Result
@@ -133,18 +136,18 @@ def test_overlapped_commit_failure_keeps_suggest_batch_consistent(
     from orion_tpu.utils.exceptions import DatabaseError
 
     storage = create_storage({"type": "memory"})
-    real_register = storage.register_trials
+    real_register_docs = storage.register_trial_docs
     state = {"fail_next": False}
 
-    def failing_register(trials):
+    def failing_register_docs(docs):
         if state["fail_next"]:
             state["fail_next"] = False
             if applied_before_failure:
-                real_register(trials)  # applied; the "reply" is then lost
+                real_register_docs(docs)  # applied; the "reply" is then lost
             raise DatabaseError("connection lost during batch commit")
-        return real_register(trials)
+        return real_register_docs(docs)
 
-    storage.register_trials = failing_register
+    storage.register_trial_docs = failing_register_docs
     exp = build_experiment(
         storage,
         "exp",
@@ -153,15 +156,18 @@ def test_overlapped_commit_failure_keeps_suggest_batch_consistent(
         algorithms="random",
         pool_size=4,
     ).instantiate(seed=7)
-    producer = Producer(exp)
+    producer = Producer(exp, pipeline_depth=pipeline_depth)
     producer.update()
     assert producer.produce(4) == 4  # round 0: clean commit + speculation
     assert producer._speculative is not None
+    assert len(producer._spec_ring) == pipeline_depth  # ring filled
 
     state["fail_next"] = True
     producer.update()
     with pytest.raises(DatabaseError):
         producer.produce(4)  # round 1: the overlapped commit fails
+    # EVERY ring slot conditioned on the failed batch is gone.
+    assert len(producer._spec_ring) == 0
 
     producer.update()
     assert producer.produce(4) == 4  # round 2: recovery
@@ -325,6 +331,67 @@ def test_netdb_pipeline_cut_mid_batch_applies_exact_prefix(proxied_netdb):
     assert isinstance(outcomes[0], DuplicateKeyError)
     assert not any(isinstance(o, Exception) for o in outcomes[1:])
     assert len(server.db.read("docs")) == 3
+
+
+@pytest.mark.parametrize("failure", ["drop_reply", "drop_request"])
+@pytest.mark.parametrize("fail_round", [0, 1, 2])
+def test_depth_n_pipeline_converges_through_netdb_failure_at_any_ring_slot(
+    proxied_netdb, fail_round, failure
+):
+    """A depth-3 producer ring over a REAL netdb connection (through the
+    FaultProxy): kill the register commit of round ``fail_round`` — while
+    up to 3 speculative rounds are in flight — in both failure shapes
+    (applied-and-reply-lost and never-applied).  Whatever slot of the ring
+    the failure lands under, the run converges: the failed round either
+    raises (single-attempt retry policy) and is absent/present-exactly-once,
+    later rounds register cleanly from a rebuilt ring, and no point is
+    ever double-registered."""
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.storage.base import DocumentStorage
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    db, server, proxy = proxied_netdb
+    # max_attempts=1: the wire failure must SURFACE to the producer (the
+    # retry policy absorbing it is the separate, also-converging leg the
+    # full-stack test below covers) so the ring-discard contract is what
+    # recovers the run.
+    storage = DocumentStorage(db, retry={"max_attempts": 1, "base_delay": 0.01})
+    exp = build_experiment(
+        storage,
+        "ring-crash",
+        priors={"x": "uniform(0, 1)", "y": "uniform(0, 1)"},
+        max_trials=1000,
+        algorithms="random",
+        pool_size=4,
+    ).instantiate(seed=13)
+    producer = Producer(exp, pipeline_depth=3)
+    failed_rounds = 0
+    for rnd in range(4):
+        producer.update()
+        if rnd == fail_round:
+            proxy.fail_next(failure)
+            with pytest.raises(DatabaseError):
+                producer.produce(4)
+            failed_rounds += 1
+            # The whole in-flight ring conditioned on the failed batch is
+            # discarded, whatever slot the failure hit.
+            assert len(producer._spec_ring) == 0
+        else:
+            assert producer.produce(4) == 4
+            assert len(producer._spec_ring) == 3
+    producer.update()
+    assert producer.produce(4) == 4  # clean convergence round
+    trials = exp.fetch_trials()
+    # Zero duplicates across every round, failed one included.
+    assert len({t.id for t in trials}) == len(trials)
+    # The failed round is absent (never-applied) or present exactly once
+    # (applied-and-reply-lost); every other round landed exactly once.
+    clean_total = (5 - failed_rounds) * 4
+    assert len(trials) in (clean_total, clean_total + 4)
+    if failure == "drop_request":
+        # Never-applied: the bytes never reached the server.
+        assert len(trials) == clean_total
 
 
 def test_netdb_storage_layer_converges_through_reply_lost(proxied_netdb):

@@ -1,0 +1,142 @@
+"""Columnar trial documents (ISSUE 13 tentpole c): ``TrialBatch`` /
+``compute_batch_ids`` must be drop-in identical to the per-trial
+``Trial``/``to_dict`` pipeline — ids bit-identical (the md5 IS the storage
+unique index every dedup/crash-consistency contract keys on), documents
+key-for-key equal, and the registration path writing the exact same rows.
+"""
+
+import numpy as np
+import pytest
+
+from orion_tpu.core.trial import Trial, TrialBatch, compute_batch_ids
+from orion_tpu.storage import create_storage
+
+
+PARAM_ROWS = [
+    {"x": 0.25, "y": 3, "opt": "adam"},
+    {"x": -1.5e-7, "y": 0, "opt": "sgd"},
+    {"x": float("nan"), "y": 9, "opt": "adam"},
+    {"x": float("inf"), "y": -2, "opt": "rmsprop"},
+    {"x": 0.1 + 0.2, "y": 2**40, "opt": ""},
+    {"x": np.float64(0.75), "y": np.int64(4), "opt": np.str_("adam")},
+    {"x": np.asarray([[1.0, 2.0], [3.0, 4.0]]), "y": 1, "opt": "adam"},
+    {"x": [1, 2, (3, 4)], "y": 1, "opt": None},
+    {"x": True, "y": False, "opt": "quote'and\"both"},
+]
+
+
+def test_compute_batch_ids_matches_trial_compute_id():
+    ids = compute_batch_ids("exp-id", PARAM_ROWS)
+    want = [Trial.compute_id("exp-id", p, lie=False) for p in PARAM_ROWS]
+    assert ids == want
+    lies = compute_batch_ids("exp-id", PARAM_ROWS, lie=True)
+    assert lies == [Trial.compute_id("exp-id", p, lie=True) for p in PARAM_ROWS]
+    assert set(ids).isdisjoint(lies)
+
+
+def test_compute_batch_ids_mixed_key_rows_fall_back():
+    """Rows whose key sets differ from the first row's (or carry non-str
+    keys) must route through the reference path, never a wrong fast-path
+    ordering."""
+    rows = [
+        {"a": 1, "b": 2},
+        {"a": 1, "c": 2},  # different key set
+        {1: "x", "a": 0},  # non-str key in FIRST position would kill fast path
+    ]
+    assert compute_batch_ids("e", rows) == [
+        Trial.compute_id("e", p) for p in rows
+    ]
+    # Non-str keys in the first row disable the fast path for the batch.
+    rows2 = [{1: "x"}, {1: "y"}]
+    assert compute_batch_ids("e", rows2) == [
+        Trial.compute_id("e", p) for p in rows2
+    ]
+
+
+def test_compute_batch_ids_empty():
+    assert compute_batch_ids("e", []) == []
+
+
+def test_to_docs_matches_trial_to_dict():
+    rows = [dict(p) for p in PARAM_ROWS if not isinstance(p["x"], np.ndarray)]
+    batch = TrialBatch(rows).prepare("exp-7", parents=["p1", "p2"],
+                                    submit_time=1234.5)
+    docs = batch.to_docs()
+    for doc, params in zip(docs, rows):
+        trial = Trial(params=params)
+        trial.experiment = "exp-7"
+        trial.parents = ["p1", "p2"]
+        trial.submit_time = 1234.5
+        want = trial.to_dict()
+        assert doc == want
+        assert list(doc) == list(want)  # key order too (canonical JSON forms)
+
+
+def test_trials_materialize_with_frozen_ids():
+    batch = TrialBatch([{"x": 0.5}, {"x": 0.75}]).prepare("e", parents=["p"])
+    trials = batch.trials()
+    assert [t.id for t in trials] == batch.ids
+    assert all(t._id_override is not None for t in trials)
+    assert trials[0].params == {"x": 0.5}
+    assert batch.trial_at(1) is trials[1]
+    # Unprepared batches still materialize (ids computed per access).
+    raw = TrialBatch([{"x": 0.1}])
+    assert raw.trials()[0].params == {"x": 0.1}
+
+
+def test_register_trial_batch_writes_identical_rows_as_register_trials():
+    """The columnar registration path must store byte-for-byte what the
+    Trial path stores (the depth-1 differential's storage half)."""
+    rows = [{"x": i / 8, "y": i} for i in range(8)]
+
+    columnar = create_storage({"type": "memory"})
+    batch = TrialBatch([dict(r) for r in rows]).prepare(
+        "e", parents=["root"], submit_time=99.0
+    )
+    outcomes = columnar.register_trial_docs(batch.to_docs())
+    assert not any(isinstance(o, Exception) for o in outcomes)
+
+    classic = create_storage({"type": "memory"})
+    trials = []
+    for r in rows:
+        t = Trial(params=dict(r))
+        t.experiment = "e"
+        t.parents = ["root"]
+        t.submit_time = 99.0
+        trials.append(t)
+    classic.register_trials(trials)
+
+    got = sorted(columnar._db.read("trials"), key=lambda d: d["_id"])
+    want = sorted(classic._db.read("trials"), key=lambda d: d["_id"])
+    assert got == want
+
+    # Re-registering the same batch reports every slot as the duplicate it
+    # now is — the converging-retry contract the producer leans on.
+    from orion_tpu.utils.exceptions import DuplicateKeyError
+
+    again = columnar.register_trial_docs(batch.to_docs())
+    assert all(isinstance(o, DuplicateKeyError) for o in again)
+
+
+@pytest.mark.parametrize("backend", ["memory", "sqlite"])
+def test_register_trial_docs_slot_independence(tmp_path, backend):
+    config = {"type": backend}
+    if backend == "sqlite":
+        config["path"] = str(tmp_path / "b.sqlite")
+    storage = create_storage(config)
+    first = TrialBatch([{"x": 0.5}]).prepare("e", submit_time=1.0)
+    assert not any(
+        isinstance(o, Exception)
+        for o in storage.register_trial_docs(first.to_docs())
+    )
+    # A duplicate mid-batch must not block the neighbouring slots.
+    batch = TrialBatch([{"x": 0.25}, {"x": 0.5}, {"x": 0.75}]).prepare(
+        "e", submit_time=2.0
+    )
+    outcomes = storage.register_trial_docs(batch.to_docs())
+    from orion_tpu.utils.exceptions import DuplicateKeyError
+
+    assert not isinstance(outcomes[0], Exception)
+    assert isinstance(outcomes[1], DuplicateKeyError)
+    assert not isinstance(outcomes[2], Exception)
+    assert len(storage.fetch_trials(uid="e")) == 3
