@@ -1130,16 +1130,20 @@ def main_chaos(rounds=6, q=8, seed=11):
 
 
 def run_soak(n_workers=1000, n_experiments=24, trials_per_worker=3,
-             n_routers=32, replicas=2, periodic_chaos=True, deadline=600.0):
+             n_routers=32, replicas=2, periodic_chaos=True, deadline=600.0,
+             kill_primary=True):
     """The sharded control-plane load harness (ROADMAP item 3): drive
     ``n_workers`` simulated workers through consistent-hash routers
     against an in-process 3-shard x ``replicas``-replica topology of REAL
     netdb servers, under fault-proxy reconnect storms/partitions, a
-    scripted mid-run shard restart, and a replica kill.  Hard-asserts the
-    pass bar (zero lost observations, clean audits through the router AND
-    on every shard, chaos signals counted) and returns the summary block
-    for the payload.  SystemExit, not assert: the gate must hold under
-    ``python -O`` too."""
+    scripted mid-run shard restart, a replica kill — and, with
+    ``kill_primary`` (the ISSUE-14 promotion leg), a PERMANENT primary
+    loss on shard 0 that the router fleet must heal by electing the
+    caught-up replica itself.  Hard-asserts the pass bar (zero lost
+    observations, clean audits through the router AND on every shard,
+    chaos signals counted, >= 1 automatic promotion with no manual
+    restart) and returns the summary block for the payload.  SystemExit,
+    not assert: the gate must hold under ``python -O`` too."""
     import tempfile
 
     from orion_tpu import telemetry as tel
@@ -1153,15 +1157,36 @@ def run_soak(n_workers=1000, n_experiments=24, trials_per_worker=3,
                 n_shards=3, replicas=replicas, persist_dir=tmpdir
             )
 
-            def chaos_once():
+            def chaos_once(storages):
+                from orion_tpu.storage.soak import busiest_shard
+
+                # Kill the BUSIEST shard's primary (the one the ring gave
+                # the most experiments): the promotion must heal a shard
+                # under live write load, not an idle corner.
+                victim = (
+                    busiest_shard(topo, storages[0].db, n_experiments)
+                    if kill_primary
+                    else None
+                )
                 topo.drop_all()
-                topo.shards[1].restart_primary()
-                # Replica 0 of EVERY shard dies so the read path's
-                # failover leg fires regardless of where the ring placed
-                # the experiments (multi-replica topologies keep serving
-                # replica reads from the survivors).
+                restart_index = next(
+                    i for i in range(len(topo.shards)) if i != victim
+                )
+                topo.shards[restart_index].restart_primary()
+                # Replica 0 of (nearly) every shard dies so the read
+                # path's failover leg fires regardless of where the ring
+                # placed the experiments; the victim keeps its replicas —
+                # it is about to lose its PRIMARY instead.
                 for shard in topo.shards:
+                    if shard.index == victim:
+                        continue
                     shard.kill_replica(0)
+                if kill_primary:
+                    # The promotion leg: wait until a replica holds the
+                    # full position (replication is async), then kill the
+                    # primary for good.  No restart — the routers must
+                    # elect the survivor on their own.
+                    topo.shards[victim].kill_primary()
 
             try:
                 result = drive_soak(
@@ -1189,6 +1214,10 @@ def run_soak(n_workers=1000, n_experiments=24, trials_per_worker=3,
         raise SystemExit(f"router view != sum of shards: {summary}")
     if result.restarts < 1 or result.failovers < 1 or result.reconnects < 1:
         raise SystemExit(f"soak chaos signals never fired: {summary}")
+    if kill_primary and result.promotions < 1:
+        raise SystemExit(
+            f"primary killed but NO automatic promotion happened: {summary}"
+        )
     summary["trials_per_second"] = (
         round(result.completed / result.duration_s, 1)
         if result.duration_s else None
@@ -1196,16 +1225,85 @@ def run_soak(n_workers=1000, n_experiments=24, trials_per_worker=3,
     return summary
 
 
+def run_rebalance_soak(n_workers=200, n_experiments=16, trials_per_worker=3,
+                       n_routers=8, deadline=300.0):
+    """The rebalance-mid-soak leg (ISSUE 14): a live topology GROWS by one
+    shard at the worker barrier, every router retargets in place, and
+    ``db rebalance``'s migrator moves ~1/N of the experiments — byte-
+    identical copies verified doc by doc, clean destination audits, an
+    atomic placement flip, source deletion — before the workers resume
+    and finish on the new ring.  Hard gates: >= 1 experiment moved, the
+    moved fraction stays near 1/N, zero lost observations, clean audits
+    on EVERY shard (source and destination included)."""
+    import tempfile
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.storage.soak import (
+        SoakTopology,
+        drive_soak,
+        grow_and_rebalance,
+    )
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    outcome = {}
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-rebal-") as tmpdir:
+            topo = SoakTopology(n_shards=3, replicas=1, persist_dir=tmpdir)
+
+            def rebalance_hook(storages):
+                outcome.update(grow_and_rebalance(topo, storages))
+
+            try:
+                result = drive_soak(
+                    topo,
+                    n_workers=n_workers,
+                    n_experiments=n_experiments,
+                    trials_per_worker=trials_per_worker,
+                    n_routers=n_routers,
+                    chaos=False,
+                    mid_hook=rebalance_hook,
+                    deadline=deadline,
+                )
+            finally:
+                topo.stop()
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    summary = result.summary()
+    summary["rebalance"] = outcome
+    if not outcome.get("executed"):
+        raise SystemExit(f"rebalance never executed: {summary}")
+    planned = outcome["planned"]
+    if planned["moves"] < 1:
+        raise SystemExit(f"rebalance moved NOTHING: {summary}")
+    n_shards = outcome["n_shards"]
+    if planned["move_fraction"] > 2.5 / n_shards:
+        raise SystemExit(
+            f"rebalance moved far more than ~1/N of the keyspace: {summary}"
+        )
+    if result.lost_observations != 0:
+        raise SystemExit(f"rebalance soak LOST observations: {summary}")
+    if not result.audits_clean:
+        raise SystemExit(f"rebalance soak audits dirty: {summary}")
+    if sum(result.completed_per_shard.values()) != result.completed:
+        raise SystemExit(f"router view != sum of shards: {summary}")
+    return summary
+
+
 def main_soak(n_workers=1000):
-    """``bench.py --soak [--workers N]``: the 1000-worker headline run."""
+    """``bench.py --soak [--workers N]``: the 1000-worker headline run +
+    the rebalance-mid-soak leg."""
     summary = run_soak(n_workers=n_workers)
+    rebalance = run_rebalance_soak(n_workers=min(200, n_workers))
     payload = {
         "metric": (
             f"sharded soak: {n_workers} workers, 3 shards x 2 replicas, "
-            "storms+partition+restart"
+            "storms+partition+restart+kill-primary(promotion)+rebalance"
         ),
         "n_workers": n_workers,
         "soak": summary,
+        "rebalance_soak": rebalance,
     }
     print(json.dumps(payload))
 
@@ -1302,12 +1400,21 @@ def main_smoke(trace_out="bench_trace.json"):
         )
     # Tiny sharded-soak leg (storage/shard.py + soak.py): 8 workers over a
     # real 3-shard x 1-replica topology with the scripted storm + shard
-    # restart + replica kill — run_soak hard-asserts zero lost
-    # observations, clean audits on every shard, and that the chaos
-    # signals (restart, failover, reconnects) actually fired.
+    # restart + replica kill + PERMANENT shard-0 primary kill — run_soak
+    # hard-asserts zero lost observations, clean audits on every shard,
+    # that the chaos signals (restart, failover, reconnects) actually
+    # fired, and that >= 1 AUTOMATIC replica promotion healed the killed
+    # shard with no human in the loop.
     soak_block = run_soak(
         n_workers=8, n_experiments=4, trials_per_worker=4, n_routers=2,
         replicas=1, periodic_chaos=False, deadline=120.0,
+    )
+    # Tiny rebalance-mid-soak leg: the topology grows by one shard at the
+    # worker barrier, the migrator moves ~1/N of the experiments (byte-
+    # identical, audited), workers finish on the new ring — zero lost.
+    rebalance_block = run_rebalance_soak(
+        n_workers=8, n_experiments=8, trials_per_worker=4, n_routers=2,
+        deadline=120.0,
     )
     trace_file, host_attribution = _safe_trace(trace_out)
     payload = _json_payload(
@@ -1335,6 +1442,7 @@ def main_smoke(trace_out="bench_trace.json"):
     payload["tsan_violations"] = tsan_report.violation_count()
     payload["serve"] = serve_block
     payload["soak"] = soak_block
+    payload["rebalance_soak"] = rebalance_block
     # Hard wall-=-device gate (ISSUE 13): smoke fails loudly on host-tax
     # regressions instead of warning into a log nobody reads.
     _check_host_budget(payload, hard=True)

@@ -334,9 +334,106 @@ class UnmergedFanoutError(Rule):
         return False
 
 
+#: STO005 markers: the placement-override collection (either spelling) and
+#: the epoch-mutating wire op.
+_PLACEMENT_NAMES = frozenset({"_placement", "PLACEMENT_COLLECTION"})
+_PLACEMENT_MUTATORS = frozenset({"write", "read_and_write", "remove"})
+_EPOCH_WIRE_OPS = frozenset({"promote"})
+
+
+class UnguardedPlacementMutation(Rule):
+    id = "STO005"
+    name = "unguarded-placement-mutation"
+    description = (
+        "Placement/epoch state is the routing ground truth of the live "
+        "control plane: every mutation of the `_placement` collection "
+        "(write/read_and_write/remove) and every `promote` wire call must "
+        "ride a RetryPolicy.run(..., mode=...) with an EXPLICIT "
+        "applied-or-not mode in the same (outermost) function — a bare "
+        "call that dies mid-wire leaves the migration state machine "
+        "half-flipped with no declared convergence contract."
+    )
+
+    def _outermost_functions(self, tree):
+        """Top-level functions and class methods, NOT nested defs: the
+        policy.run(mode=...) covering a nested thunk lives in the
+        enclosing function, which is the unit of review."""
+        stack = [(tree, False)]
+        while stack:
+            node, inside_fn = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if not inside_fn:
+                        yield child
+                    stack.append((child, True))
+                else:
+                    stack.append((child, inside_fn))
+
+    @staticmethod
+    def _first_arg_marks_placement(node):
+        if not node.args:
+            return False
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and first.value == "_placement":
+            return True
+        name = dotted_name(first) or ""
+        return name.split(".")[-1] in _PLACEMENT_NAMES
+
+    def _flagged_calls(self, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or not isinstance(
+                node.func, ast.Attribute
+            ):
+                continue
+            attr = node.func.attr
+            if attr in _PLACEMENT_MUTATORS and self._first_arg_marks_placement(
+                node
+            ):
+                yield node, "placement mutation"
+            elif attr == "_call" and node.args:
+                op = node.args[0]
+                if (
+                    isinstance(op, ast.Constant)
+                    and op.value in _EPOCH_WIRE_OPS
+                ):
+                    yield node, f"'{op.value}' wire op"
+
+    @staticmethod
+    def _has_explicit_mode_run(fn):
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "run"
+                and any(kw.arg == "mode" for kw in node.keywords)
+            ):
+                return True
+        return False
+
+    def check(self, module):
+        for fn in self._outermost_functions(module.tree):
+            covered = None
+            for node, what in self._flagged_calls(fn):
+                if covered is None:
+                    covered = self._has_explicit_mode_run(fn)
+                if covered:
+                    continue
+                yield Diagnostic(
+                    module.path,
+                    node.lineno,
+                    node.col_offset,
+                    self.id,
+                    f"{what} in '{fn.name}' without a RetryPolicy.run(..., "
+                    "mode=...) in the same function — placement/epoch "
+                    "mutations must declare their applied-or-not "
+                    "convergence mode explicitly",
+                )
+
+
 STORAGE_RULES = (
     UncoveredStorageOp,
     ImplicitRetryMode,
     AmbiguousWireError,
     UnmergedFanoutError,
+    UnguardedPlacementMutation,
 )

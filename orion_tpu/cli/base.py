@@ -161,11 +161,18 @@ def build_all_experiments(args, view=True):
     return experiments
 
 
-def describe_storage_topology():
+def describe_storage_topology(probe=False):
     """One-line sharded-topology summary of the ACTIVE storage singleton
     (``audit``/``info``/``top`` fleet views print it so an operator can
     tell at a glance WHICH plane answered), or None when the storage is
-    not the consistent-hash router."""
+    not the consistent-hash router.
+
+    ``probe=True`` additionally probes every shard node's replication
+    position (one tiny ``seq`` request each) and annotates each shard
+    with its current epoch and worst replica lag (``ep2 lag:3``) — the
+    first thing an operator needs when a promotion or a lagging replica
+    is suspected.  Probing also publishes the ``netdb.replication.lag.*``
+    gauges."""
     from orion_tpu.storage.base import _storage_singleton
 
     db = getattr(_storage_singleton, "db", None)
@@ -173,13 +180,33 @@ def describe_storage_topology():
     if describe is None:
         return None
     topology = describe()
-    parts = ", ".join(
-        f"s{shard['index']}={shard['address']}"
-        + (f"(+{len(shard['replicas'])}r)" if shard["replicas"] else "")
-        for shard in topology["shards"]
-    )
+    health = {}
+    if probe:
+        replication_health = getattr(db, "replication_health", None)
+        if replication_health is not None:
+            try:
+                health = {h["index"]: h for h in replication_health()}
+            except Exception:  # pragma: no cover - a dead fleet still renders
+                health = {}
+    parts = []
+    for shard in topology["shards"]:
+        part = f"s{shard['index']}={shard['address']}"
+        if shard["replicas"]:
+            part += f"(+{len(shard['replicas'])}r)"
+        probed = health.get(shard["index"])
+        if probed is not None:
+            if probed.get("epoch"):
+                part += f" ep{probed['epoch']}"
+            if probed.get("max_lag") is not None:
+                part += f" lag:{probed['max_lag']}"
+            if probed.get("error"):
+                part += " DOWN"
+            elif probed.get("primary") != shard["address"]:
+                # A promoted replica serves this shard now.
+                part += f"->{probed['primary']}"
+        parts.append(part)
     return (
-        f"storage: {len(topology['shards'])} shard(s) [{parts}] "
+        f"storage: {len(topology['shards'])} shard(s) [{', '.join(parts)}] "
         f"vnodes={topology['vnodes']} replica_reads="
         f"{'on' if topology['replica_reads'] else 'off'}"
     )

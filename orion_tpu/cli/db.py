@@ -76,7 +76,64 @@ def add_subparser(subparsers):
         "ring placement (requires a shards: stanza / ORION_DB_SHARDS)",
     )
     _common(ring_p)
+    ring_p.add_argument(
+        "--diff",
+        action="store_true",
+        help="show the rebalance plan instead: which experiments live away "
+        "from their ring home (after a topology change) and where "
+        "`db rebalance` would move them (~1/N of the keyspace when one "
+        "shard was added)",
+    )
     ring_p.set_defaults(func=main_ring)
+
+    rebalance_p = sub.add_parser(
+        "rebalance",
+        help="move experiments to their ring homes after a topology change "
+        "(live: copy -> verify byte-identical -> atomic placement flip -> "
+        "delete source; crash-resumable — see docs/multi_node.md)",
+    )
+    _common(rebalance_p)
+    rebalance_p.add_argument(
+        "--dry-run", action="store_true",
+        help="print the plan and exit without moving anything",
+    )
+    rebalance_p.add_argument(
+        "--fence-grace", type=float, default=None, metavar="SECONDS",
+        help="how long experiments stay fenced before the flip (default: "
+        "the routers' placement-cache TTL, so every router observes the "
+        "fence before documents move)",
+    )
+    rebalance_p.set_defaults(func=main_rebalance)
+
+    backup_p = sub.add_parser(
+        "backup",
+        help="stream one consistent seq/epoch-stamped snapshot per shard "
+        "into a directory (manifest written last, atomically)",
+    )
+    _common(backup_p)
+    backup_p.add_argument(
+        "--out", required=True, metavar="DIR",
+        help="backup directory (created if missing)",
+    )
+    backup_p.set_defaults(func=main_backup)
+
+    restore_p = sub.add_parser(
+        "restore",
+        help="rebuild a FRESH topology from a `db backup` directory; "
+        "documents are routed through the CURRENT ring, so the new "
+        "topology may have a different shard count",
+    )
+    _common(restore_p)
+    restore_p.add_argument(
+        "--src", required=True, metavar="DIR",
+        help="backup directory holding manifest.json",
+    )
+    restore_p.add_argument(
+        "--force", action="store_true",
+        help="restore into a NON-empty destination (documents merge by id; "
+        "conflicting content is NOT detected — disaster recovery only)",
+    )
+    restore_p.set_defaults(func=main_restore)
 
     copy_p = sub.add_parser(
         "copy",
@@ -664,14 +721,10 @@ def main_serve(args):
     return 0
 
 
-def main_ring(args):
-    """`db ring`: the operator's placement oracle — which shard owns each
-    experiment, and what the topology looks like, computed from the SAME
-    ring every router instance builds (no server round trips needed for
-    the placement itself; the experiment list is read through the
-    router)."""
-    from orion_tpu.cli.base import describe_storage_topology
-
+def _sharded_router_or_error(args):
+    """Resolve the configured storage and require the consistent-hash
+    router; returns ``(storage, router)`` or ``(None, None)`` after
+    printing the remedy."""
     config = load_cli_config(args)
     storage = setup_storage(config["storage"], force=True)
     router = storage.db
@@ -680,12 +733,59 @@ def main_ring(args):
             "storage is not sharded; add a `shards:` stanza to the storage "
             "config (or set ORION_DB_SHARDS) — see docs/multi_node.md"
         )
+        return None, None
+    return storage, router
+
+
+def _print_plan(plan):
+    summary = plan.summary()
+    print(
+        f"rebalance plan: {summary['moves']} of {summary['experiments']} "
+        f"experiment(s) move ({summary['move_fraction']:.1%}); "
+        f"{summary['stays']} already home"
+    )
+    for move in plan.moves:
+        print(f"  {move.describe()}")
+    for exp_id, homes in plan.strays:
+        print(f"  STRAY {exp_id}: found on shards {homes} with no placement record")
+
+
+def main_ring(args):
+    """`db ring`: the operator's placement oracle — which shard owns each
+    experiment, and what the topology looks like, computed from the SAME
+    ring every router instance builds (no server round trips needed for
+    the placement itself; the experiment list is read through the
+    router).  ``--diff`` shows the rebalance plan instead: where each
+    displaced experiment currently lives vs where the ring now puts it."""
+    from orion_tpu.cli.base import describe_storage_topology
+
+    storage, router = _sharded_router_or_error(args)
+    if router is None:
         return 1
-    print(describe_storage_topology())
+    print(describe_storage_topology(probe=True))
     topology = router.describe_topology()
     for shard in topology["shards"]:
         replicas = ", ".join(shard["replicas"]) or "none"
-        print(f"  shard {shard['index']}: {shard['address']}  replicas: {replicas}")
+        serving = ""
+        if shard.get("primary") and shard["primary"] != shard["address"]:
+            serving = f"  primary NOW: {shard['primary']} (epoch {shard.get('epoch', 0)})"
+        print(
+            f"  shard {shard['index']}: {shard['address']}  "
+            f"replicas: {replicas}{serving}"
+        )
+    if getattr(args, "diff", False):
+        from orion_tpu.storage.rebalance import Rebalancer
+
+        plan = Rebalancer(router).plan()
+        _print_plan(plan)
+        if plan.moves:
+            n = len(topology["shards"])
+            print(
+                f"(~1/N invariant: {plan.move_fraction:.1%} moving vs "
+                f"1/{n} = {1 / n:.1%} expected after adding one shard)"
+            )
+            print("run `orion-tpu db rebalance` to execute this plan")
+        return 0
     docs = storage.fetch_experiments({})
     if not docs:
         print("no experiments in storage")
@@ -697,6 +797,95 @@ def main_ring(args):
             f"  {doc['name']} v{doc.get('version', 1)} "
             f"({doc['_id']}) -> shard {shard}"
         )
+    return 0
+
+
+def main_rebalance(args):
+    """`db rebalance`: execute the ring diff — migrate every displaced
+    experiment to its ring home through the crash-resumable placement
+    state machine (storage/rebalance.py).  Re-run after any crash: the
+    plan is recomputed from the standing placement docs and resumes."""
+    from orion_tpu.storage.rebalance import Rebalancer
+
+    _storage, router = _sharded_router_or_error(args)
+    if router is None:
+        return 1
+    rebalancer = Rebalancer(router, fence_grace=args.fence_grace)
+    plan = rebalancer.plan()
+    _print_plan(plan)
+    if args.dry_run or not plan.moves:
+        return 1 if plan.strays else 0
+    if plan.strays:
+        print("ERROR: strays present — resolve before rebalancing")
+        return 1
+    rebalancer.run(plan)
+    moved = len(plan.moves)
+    print(f"rebalanced {moved} experiment(s); placement == ring again")
+    return 0
+
+
+def main_backup(args):
+    """`db backup --out DIR`: one consistent snapshot per shard + manifest."""
+    import sys
+
+    from orion_tpu.storage.backup import backup_topology
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    config = load_cli_config(args)
+    storage = setup_storage(config["storage"], force=True)
+    db = storage.db
+    if not hasattr(db, "_call") and not hasattr(db, "shard_connections"):
+        print(
+            "ERROR: `db backup` snapshots network/sharded storage; for "
+            "file-backed storage use `db dump`",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        manifest = backup_topology(db, args.out)
+    except DatabaseError as exc:
+        print(f"ERROR: backup failed: {exc}", file=sys.stderr)
+        return 1
+    total = sum(entry["docs"] for entry in manifest["shards"])
+    for entry in manifest["shards"]:
+        print(
+            f"  shard {entry['index']} ({entry['address']}): "
+            f"{entry['docs']} docs at seq {entry['seq']} epoch {entry['epoch']}"
+        )
+    print(f"backed up {total} documents from "
+          f"{len(manifest['shards'])} shard(s) to {args.out}")
+    return 0
+
+
+def main_restore(args):
+    """`db restore --src DIR`: rebuild a fresh topology from a backup."""
+    import sys
+
+    from orion_tpu.storage.backup import restore_topology
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    config = load_cli_config(args)
+    storage = setup_storage(config["storage"], force=True)
+    db = storage.db
+    if not hasattr(db, "apply_batch"):
+        print(
+            "ERROR: `db restore` targets network/sharded storage; for "
+            "file-backed storage use `db load`",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        summary = restore_topology(db, args.src, require_empty=not args.force)
+    except DatabaseError as exc:
+        print(f"ERROR: restore failed: {exc}", file=sys.stderr)
+        return 1
+    for collection, count in sorted(summary["collections"].items()):
+        if count:
+            print(f"  {collection}: {count}")
+    print(
+        f"restored {summary['documents']} documents through the current "
+        "ring; run `orion-tpu audit --all` to verify"
+    )
     return 0
 
 

@@ -301,7 +301,7 @@ def main(args):
         # experiments from EVERY shard are in the list).
         from orion_tpu.cli.base import describe_storage_topology
 
-        topology = describe_storage_topology()
+        topology = describe_storage_topology(probe=True)
         if topology is not None:
             print(topology)
         if not experiments:

@@ -325,7 +325,11 @@ def render_fleet(snaps):
     lines = [f"orion-tpu top --all   experiments: {len(snaps)}"]
     from orion_tpu.cli.base import describe_storage_topology
 
-    topology = describe_storage_topology()
+    # probe=True: the fleet header shows per-shard epoch + replication lag
+    # (one tiny seq request per node per frame — the operator's first
+    # question when a shard looks wrong is "who is primary and how far
+    # behind are the replicas").
+    topology = describe_storage_topology(probe=True)
     if topology is not None:
         # The fleet the table shows spans every shard (the router resolved
         # it); the header says so.

@@ -146,6 +146,11 @@ class _MetricsHandler(http.server.BaseHTTPRequestHandler):
             from orion_tpu.devmem import sample_memory
 
             sample_memory(force=True)
+            # Replication-lag gauges for any sharded router this process
+            # holds (rate-limited internally; one tiny seq probe per node).
+            from orion_tpu.storage.shard import sample_replication_lag
+
+            sample_replication_lag()
             body = render_exposition(self.server.registry.snapshot()).encode()
             content_type = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?", 1)[0] == "/healthz":

@@ -1145,6 +1145,11 @@ def create_storage(config=None):
             # replicas ride inside each entry; docs/multi_node.md).
             from orion_tpu.storage.shard import ShardedNetworkDB
 
+            from orion_tpu.storage.shard import (
+                DEFAULT_PROMOTE_AFTER_S,
+                PLACEMENT_TTL_S,
+            )
+
             return DocumentStorage(
                 ShardedNetworkDB(
                     config["shards"],
@@ -1154,6 +1159,15 @@ def create_storage(config=None):
                     reconnect_jitter=config.get("reconnect_jitter", 0.1),
                     shard_retry=config.get("shard_retry"),
                     replica_reads=config.get("replica_reads", True),
+                    # Self-healing knobs (docs/multi_node.md): automatic
+                    # replica promotion + its confirmation window, and the
+                    # placement-override cache TTL the rebalance fence
+                    # grace must cover.
+                    auto_promote=config.get("auto_promote", True),
+                    promote_after=config.get(
+                        "promote_after", DEFAULT_PROMOTE_AFTER_S
+                    ),
+                    placement_ttl=config.get("placement_ttl", PLACEMENT_TTL_S),
                 ),
                 retry=retry,
             )

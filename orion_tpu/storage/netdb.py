@@ -90,10 +90,13 @@ _MUTATING_OPS = frozenset(
 )
 
 # Server-level ops outside the document contract: the replication stream a
-# primary pushes to its read replicas, and the applied-sequence probe the
-# pushers (and operators) use to measure replica lag.  Both require
-# authentication — the replication stream is a full write channel.
-_SERVER_OPS = frozenset({"replicate", "seq"})
+# primary pushes to its read replicas, the applied-sequence probe the
+# pushers (and operators) use to measure replica lag, the promotion op a
+# router's election sends to the most-caught-up replica, and the
+# consistent-snapshot export behind `orion-tpu db backup`.  All require
+# authentication — the replication stream is a full write channel, and
+# promotion/snapshot reshape or export the whole store.
+_SERVER_OPS = frozenset({"replicate", "seq", "promote", "snapshot"})
 
 #: Bounded primary-side replication log (ops, not bytes).  A replica that
 #: falls further behind than this gets a full snapshot resync instead of an
@@ -304,16 +307,31 @@ class _Handler(socketserver.StreamRequestHandler):
             }
         if op == "seq":
             return {"ok": True, "result": self.server.seq_info()}
-        if op == "replicate":
+        if op == "snapshot":
+            return {"ok": True, "result": self.server.snapshot_payload()}
+        if op in ("replicate", "promote"):
             try:
                 args = request.get("args") or []
                 payload = args[0] if args else None
-                return {"ok": True, "result": self.server.handle_replicate(payload)}
+                handler = (
+                    self.server.handle_replicate
+                    if op == "replicate"
+                    else self.server.handle_promote
+                )
+                return {"ok": True, "result": handler(payload)}
             except Exception as exc:  # pragma: no cover - defensive
-                log.exception("replicate failed")
+                log.exception("%s failed", op)
                 return _encode_outcome(exc)
         if op == "batch":
             return self._batch_dispatch(db, request)
+        if op in _MUTATING_OPS and self.server.refuses_mutations():
+            # Epoch fencing, server side: a replica (including a demoted
+            # stale primary) must never apply a client mutation — accepting
+            # one would fork it from the authoritative primary's timeline
+            # and the divergence would be silently erased by the next
+            # resync.  Refused BEFORE any apply, so nothing was applied and
+            # the router's retry can safely re-route to the real primary.
+            return self.server.not_primary_reply()
         # Distributed tracing: a request may carry an optional `ctx` field
         # (the client's ambient TraceContext) — adopted as the parent of
         # this server's apply span.  Pre-upgrade clients simply omit it;
@@ -342,6 +360,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 out = {"ok": True, "result": result}
             if seq is not None:
                 out["seq"] = seq
+                # The epoch rides next to the seq so routers can fence a
+                # stale primary's replies (shard.py's promotion protocol);
+                # epoch 0 = replication never configured, nothing stamped.
+                epoch = self.server.epoch
+                if epoch:
+                    out["epoch"] = epoch
             return out
         except Exception as exc:
             if not isinstance(exc, (DuplicateKeyError, KeyError)):
@@ -385,9 +409,12 @@ class _Handler(socketserver.StreamRequestHandler):
                 "error": "DatabaseError",
                 "message": f"malformed batch request: {exc}",
             }
+        mutating = any(op in _MUTATING_OPS for op, _, _ in normalized)
+        if mutating and self.server.refuses_mutations():
+            # Same epoch fence as the single-op path: nothing applied.
+            return self.server.not_primary_reply()
         t0, ctx = self.server.adopt_begin(request)
         try:
-            mutating = any(op in _MUTATING_OPS for op, _, _ in normalized)
             # All-read batch (the producer's fetch_update_view pair): the
             # replica stamp is taken BEFORE the batch runs — a lower bound
             # on the observed state, same rationale as the single-op path.
@@ -400,6 +427,9 @@ class _Handler(socketserver.StreamRequestHandler):
             out = {"ok": True, "result": [_encode_outcome(r) for r in results]}
             if seq is not None:
                 out["seq"] = seq
+                epoch = self.server.epoch
+                if epoch:
+                    out["epoch"] = epoch
             return out
         except Exception as exc:
             # Whole-batch failure (e.g. a fault-injected mid-batch kill):
@@ -436,6 +466,11 @@ class _ReplicaLink:
 
     PUSH_BATCH = 256
 
+    #: Upper bound of the jittered pre-resync sleep: spreads the (gated,
+    #: serialized) snapshot dumps of a replica restart storm so the
+    #: primary's lock sees breathing room between them.
+    RESYNC_JITTER_S = 0.05
+
     def __init__(self, server, addr, secret=None):
         self.server = server
         self.host, self.port = _parse_addr(addr)
@@ -443,6 +478,11 @@ class _ReplicaLink:
             host=self.host, port=self.port, timeout=10.0, secret=secret
         )
         self.acked_seq = None  # unknown until the first probe
+        #: Set when the replica's last reply demanded a resync (an epoch
+        #: change or a fork repair): the next cycle must ship a snapshot
+        #: even if the bounded log happens to cover the replica's position
+        #: — entry replay across a fork corrupts silently.
+        self.force_resync = False
         self._wake = threading.Event()
         self._stopped = threading.Event()
         self._thread = threading.Thread(
@@ -514,36 +554,76 @@ class _ReplicaLink:
         """Drain everything the replica has not acknowledged yet."""
         while not self._stopped.is_set():
             if self.acked_seq is None:
-                info = self.client._call("seq")
-                self.acked_seq = int((info or {}).get("seq", 0))
+                info = self.client._call("seq") or {}
+                peer_epoch = int(info.get("epoch", 0) or 0)
+                if peer_epoch > self.server.epoch:
+                    # The peer lives in a NEWER epoch: this server is a
+                    # stale reborn primary — demote instead of pushing a
+                    # forked history (split-brain guard, docs/multi_node.md).
+                    self.server.demote(peer_epoch)
+                    return
+                self.acked_seq = int(info.get("seq", 0))
             with self.server._repl_lock:
                 entries = [
                     list(e) for e in self.server._repl_log
                     if e[0] > self.acked_seq
                 ]
+                epoch = self.server.epoch
                 behind = self.server.seq > self.acked_seq
                 covered = bool(entries) and entries[0][0] == self.acked_seq + 1
-                snapshot = None
-                if behind and not covered:
-                    # The gap fell off the bounded log (or the replica
-                    # restarted empty): full resync from a consistent
-                    # point — taken under the replication lock, so no
-                    # mutation interleaves with the dump.
-                    snapshot = self.server._snapshot_payload_locked()
-            if snapshot is not None:
-                result = self.client._call("replicate", {"snapshot": snapshot})
-                TELEMETRY.count("netdb.replication.resyncs")
-                self.acked_seq = int((result or {}).get("seq", 0))
+            if (behind and not covered) or self.force_resync:
+                # The gap fell off the bounded log (or the replica
+                # restarted empty / demanded an epoch resync): full resync.
+                # Resyncs are BOUNDED to one replica at a time per primary
+                # (jittered): each snapshot is an O(DB-size) dump under the
+                # replication lock, and a restart storm of R replicas
+                # re-probing at once would otherwise stampede the primary
+                # with R back-to-back dumps, starving client mutations of
+                # ``_repl_lock`` for R full copies.
+                if not self.server._resync_gate.acquire(timeout=2.0):
+                    continue  # re-check _stopped, then wait our turn again
+                try:
+                    if self._stopped.is_set():
+                        return
+                    self._stopped.wait(random.random() * self.RESYNC_JITTER_S)
+                    with self.server._repl_lock:
+                        # Re-read from a consistent point — the log may
+                        # have grown while we waited for the gate.
+                        snapshot = self.server._snapshot_payload_locked()
+                    result = self.client._call(
+                        "replicate", {"snapshot": snapshot, "epoch": epoch}
+                    )
+                    TELEMETRY.count("netdb.replication.resyncs")
+                finally:
+                    self.server._resync_gate.release()
+                result = result or {}
+                if result.get("fenced"):
+                    # Promoted between our probe and this push: same
+                    # demotion as a fenced entry push.
+                    self.server.demote(int(result.get("epoch", 0) or 0))
+                    return
+                self.force_resync = False
+                self.acked_seq = int(result.get("seq", 0))
                 continue
             if not entries:
                 return
             chunk = entries[: self.PUSH_BATCH]
-            result = self.client._call("replicate", {"entries": chunk}) or {}
+            result = self.client._call(
+                "replicate", {"entries": chunk, "epoch": epoch}
+            ) or {}
             TELEMETRY.count("netdb.replication.pushes")
+            if result.get("fenced"):
+                # The replica refused this epoch: a newer primary owns the
+                # stream now.  Demote; never push a stale fork.
+                self.server.demote(int(result.get("epoch", 0) or 0))
+                return
             self.acked_seq = int(result.get("seq", 0))
             if result.get("resync"):
-                # The replica saw a sequence gap mid-chunk; loop back —
-                # the covered/behind check above decides replay vs resync.
+                # The replica saw a sequence gap (or an epoch change /
+                # fork) mid-chunk; ship a snapshot next cycle — the log
+                # may well still "cover" the reported position, but the
+                # replica has declared entry replay unsafe.
+                self.force_resync = True
                 continue
 
 
@@ -636,12 +716,34 @@ class DBServer(socketserver.ThreadingTCPServer):
         self._is_replica = bool(replica)
         self._repl_log = deque(maxlen=REPL_LOG_CAP)
         self._repl_links = []
-        # The applied/assigned sequence survives restarts THROUGH the store
-        # itself (a meta doc): a restarted primary must keep numbering where
-        # it left off or replicas would silently discard its new mutations
-        # as already-seen, and a restarted persisted replica must report its
-        # true position so the pusher resumes (or resyncs) correctly.
-        self.seq = self._load_seq()
+        #: Serializes full snapshot resyncs across this primary's pusher
+        #: threads (see _ReplicaLink._push_pending).
+        self._resync_gate = threading.BoundedSemaphore(1)
+        #: Set when this server's history may have FORKED from the
+        #: authoritative stream (a demoted stale primary, or a replica that
+        #: observed an epoch change): seq probes report 0 until a full
+        #: snapshot overwrites the fork — entry replay on top of diverged
+        #: state would corrupt silently.
+        self._resync_pending = False
+        #: True for any server that ever served as a primary (constructed
+        #: replicating, or promoted): its local history may contain writes
+        #: no other node has, so an epoch change can never be absorbed by
+        #: entry replay — only by a snapshot.
+        self._was_primary = bool(replicate_to)
+        # The applied/assigned sequence AND the replication epoch survive
+        # restarts THROUGH the store itself (a meta doc): a restarted
+        # primary must keep numbering where it left off or replicas would
+        # silently discard its new mutations as already-seen; a restarted
+        # STALE primary must come back knowing which epoch it last served
+        # so a single contact with a newer-epoch peer demotes it.
+        self.seq, self.epoch = self._load_replmeta()
+        if replicate_to and self.epoch == 0:
+            # A replicating primary always serves a concrete epoch (>= 1):
+            # epoch 0 means "replication never configured" and is never
+            # stamped on the wire.
+            with self._repl_lock:
+                self.epoch = 1
+                self._persist_seq_locked()
         super().__init__((host, port), _Handler)
         for addr in replicate_to or ():
             link = _ReplicaLink(self, addr, secret=secret)
@@ -681,6 +783,15 @@ class DBServer(socketserver.ThreadingTCPServer):
                 pass
 
     # --- replication ---------------------------------------------------------
+    @property
+    def _replicating(self):
+        """True when this server participates in the replication protocol:
+        it pushes to live links, OR it carries a concrete epoch (a
+        promoted primary whose peers are all currently dead must still
+        number and epoch-stamp its mutations — its log is what a reborn
+        peer replays, and the stamp is the routers' fencing signal)."""
+        return bool(self._repl_links) or self.epoch > 0
+
     def apply_replicated(self, op, args, kwargs, method):
         """Apply one mutating op; when this server replicates, the apply and
         its log append happen under ONE lock so the log order IS the apply
@@ -688,7 +799,7 @@ class DBServer(socketserver.ThreadingTCPServer):
         state).  Only a SUCCESSFUL apply is logged — a refused op
         (DuplicateKeyError) changed nothing and replaying it would at best
         waste a wire trip.  Returns ``(result, seq_or_None)``."""
-        if not self._repl_links:
+        if not self._replicating:
             return method(*args, **kwargs), None
         with self._repl_lock:
             result = method(*args, **kwargs)
@@ -696,29 +807,32 @@ class DBServer(socketserver.ThreadingTCPServer):
         self._notify_links()
         return result, seq
 
+    @staticmethod
+    def _run_batch(db, normalized):
+        """Apply one normalized batch against ``db`` with per-slot
+        outcomes — shared by the primary's logged path and the replica's
+        stream replay (which manages seq itself)."""
+        apply_batch = getattr(db, "apply_batch", None)
+        if apply_batch is not None:
+            return apply_batch(normalized)
+        results = []  # pragma: no cover - every in-tree store has apply_batch
+        for op, sub_args, sub_kwargs in normalized:
+            try:
+                results.append(getattr(db, op)(*sub_args, **sub_kwargs))
+            except Exception as exc:
+                results.append(exc)
+        return results
+
     def apply_batch_replicated(self, db, normalized):
         """The batch-op sibling of :meth:`apply_replicated`: the whole batch
         is ONE log entry (per-slot outcomes are deterministic replays of the
         same op stream, so a slot the primary refused is refused identically
         on the replica).  All-read batches are never logged."""
-
-        def run():
-            apply_batch = getattr(db, "apply_batch", None)
-            if apply_batch is not None:
-                return apply_batch(normalized)
-            results = []  # pragma: no cover - every in-tree store has apply_batch
-            for op, sub_args, sub_kwargs in normalized:
-                try:
-                    results.append(getattr(db, op)(*sub_args, **sub_kwargs))
-                except Exception as exc:
-                    results.append(exc)
-            return results
-
         mutating = any(op in _MUTATING_OPS for op, _, _ in normalized)
-        if not self._repl_links or not mutating:
-            return run(), None
+        if not self._replicating or not mutating:
+            return self._run_batch(db, normalized), None
         with self._repl_lock:
-            results = run()
+            results = self._run_batch(db, normalized)
             seq = self._log_entry_locked(
                 "batch",
                 [[[op, list(a), dict(k)] for op, a, k in normalized]],
@@ -732,15 +846,58 @@ class DBServer(socketserver.ThreadingTCPServer):
         (seqs at or below the applied position are dropped — resends
         converge), or a full ``snapshot``.  A mid-chunk sequence GAP stops
         the replay and reports ``resync`` so the pusher falls back to a
-        snapshot instead of applying out of order."""
+        snapshot instead of applying out of order.
+
+        **Epoch discipline** (the promotion protocol's replication half):
+        a push from a LOWER epoch is fenced — refused outright with the
+        current epoch in the reply, so a stale reborn primary demotes
+        itself instead of overwriting the promoted timeline.  A push from
+        a HIGHER epoch demotes this server if it ever was a primary (its
+        unreplicated tail is a condemned fork) and, for any server with
+        state, demands a full snapshot instead of entry replay — entries
+        replayed across an epoch boundary could land on top of a fork and
+        corrupt silently.  Epoch-less pushes (pre-upgrade primaries) are
+        treated as same-epoch."""
         payload = payload or {}
-        self._is_replica = True
+        has_epoch = "epoch" in payload
+        push_epoch = int(payload.get("epoch", 0) or 0)
+        doomed_links = []
+        demoted = False
+        own_epoch = 0
         with self._repl_lock:
+            if has_epoch and self.epoch and push_epoch < self.epoch:
+                return {
+                    "seq": self.seq,
+                    "resync": False,
+                    "fenced": True,
+                    "epoch": self.epoch,
+                }
+            epoch_advanced = has_epoch and push_epoch > self.epoch
+            if epoch_advanced and (self._was_primary or self._repl_links):
+                # A primary (current or former) hearing a newer epoch:
+                # demote NOW — every local write since the election is a
+                # fork no other node acknowledges.
+                doomed_links, self._repl_links = self._repl_links, []
+                self._resync_pending = True
+                demoted = True
+                own_epoch = self.epoch
+            self._is_replica = True
             snapshot = payload.get("snapshot")
             if snapshot is not None:
                 self._apply_snapshot_locked(snapshot)
+                self._resync_pending = False
                 applied, resync = self.seq, False
+            elif self._resync_pending or (epoch_advanced and self.seq > 0):
+                # A fork is pending repair (or this replica's tail may
+                # extend past the new primary's fork point): only a
+                # snapshot is safe.  Report position 0 so the pusher's
+                # gap logic takes the resync path.
+                self._resync_pending = True
+                applied, resync = 0, True
             else:
+                if epoch_advanced:
+                    # Fresh follower (no state): adopt the stream's epoch.
+                    self.epoch = push_epoch  # lint: disable=LCK002 -- under _repl_lock
                 applied, resync = self.seq, False
                 for entry in payload.get("entries") or []:
                     seq = int(entry[0])
@@ -757,7 +914,9 @@ class DBServer(socketserver.ThreadingTCPServer):
                             normalized = [
                                 (e[0], list(e[1]), dict(e[2])) for e in args[0]
                             ]
-                            self.apply_batch_replicated(self._meta_db, normalized)
+                            # Direct apply: the stream replay manages seq
+                            # itself — the logged path would double-number.
+                            self._run_batch(self._meta_db, normalized)
                         else:
                             getattr(self._meta_db, op)(*args, **kwargs)
                     except (DuplicateKeyError, KeyError):
@@ -772,13 +931,134 @@ class DBServer(socketserver.ThreadingTCPServer):
                     applied = seq
                 self.seq = applied
                 self._persist_seq_locked()
+        for link in doomed_links:
+            link.stop(flush=False)
+        if demoted:
+            self._note_demotion(push_epoch, own_epoch)
         self.persist_snapshot()
-        return {"seq": applied, "resync": resync}
+        return {"seq": applied, "resync": resync, "epoch": self.epoch}
+
+    def handle_promote(self, payload):
+        """The ``promote`` wire op: flip replica -> primary at a NEW epoch.
+
+        Sent by a router's election (``storage/shard.py``) to the
+        most-caught-up replica of a shard whose primary died.  Idempotent
+        and concurrent-router safe: a promotion at or below the current
+        epoch changes nothing and reports the standing state, so every
+        router converges on the same winner; a mid-resync server refuses
+        (its state is a fork in repair, not electable)."""
+        payload = payload or {}
+        new_epoch = int(payload.get("epoch", 0) or 0)
+        peers = payload.get("replicate_to") or []
+        with self._repl_lock:
+            if self._resync_pending:
+                return {
+                    "promoted": False, "primary": False,
+                    "epoch": self.epoch, "seq": 0,
+                }
+            if new_epoch <= self.epoch:
+                return {
+                    "promoted": False,
+                    "primary": not self._is_replica,
+                    "epoch": self.epoch,
+                    "seq": self.seq,
+                }
+            self.epoch = new_epoch  # lint: disable=LCK002 -- under _repl_lock
+            self._is_replica = False
+            self._was_primary = True
+            self._persist_seq_locked()
+            seq = self.seq
+            known = {(link.host, link.port) for link in self._repl_links}
+        self_addr = tuple(self.address)
+        for addr in peers:
+            parsed = _parse_addr(addr)
+            if parsed in known or parsed == self_addr:
+                continue
+            known.add(parsed)
+            link = _ReplicaLink(self, parsed, secret=self.secret)
+            with self._repl_lock:
+                self._repl_links.append(link)
+            link.start()
+        TELEMETRY.count("netdb.promotions")
+        if FLIGHT.enabled:
+            FLIGHT.record(
+                "promote",
+                args={"epoch": new_epoch, "seq": seq, "peers": len(peers)},
+            )
+        log.warning(
+            "PROMOTED to primary at epoch %d (seq %d), replicating to %d "
+            "peer(s)", new_epoch, seq, len(peers),
+        )
+        self.persist_snapshot()
+        return {"promoted": True, "primary": True, "epoch": new_epoch, "seq": seq}
+
+    def demote(self, peer_epoch):
+        """Runtime primary -> replica demotion: a peer proved a NEWER epoch
+        exists, so every local write since that election is a condemned
+        fork.  Mutations refuse from here on (``refuses_mutations``), the
+        pushers stop, and every seq probe reports 0 until the new
+        primary's snapshot overwrites the fork (``_resync_pending``)."""
+        with self._repl_lock:
+            if self._is_replica and self._resync_pending:
+                return  # already demoted and awaiting repair
+            doomed, self._repl_links = self._repl_links, []
+            self._is_replica = True
+            self._resync_pending = True
+            own_epoch = self.epoch
+        for link in doomed:
+            link.stop(flush=False)
+        self._note_demotion(peer_epoch, own_epoch)
+
+    def _note_demotion(self, peer_epoch, own_epoch):
+        TELEMETRY.count("netdb.demotions")
+        if FLIGHT.enabled:
+            FLIGHT.record(
+                "demote", args={"peer_epoch": peer_epoch, "epoch": own_epoch}
+            )
+        log.warning(
+            "DEMOTED: a peer serves epoch %d, newer than ours (%d) — now a "
+            "read replica awaiting snapshot resync",
+            peer_epoch, own_epoch,
+        )
+
+    def refuses_mutations(self):
+        """Server half of the epoch fence: replicas — including a stale
+        primary demoted by a newer epoch — never apply client mutations."""
+        return self._is_replica
+
+    def not_primary_reply(self):
+        with self._repl_lock:
+            epoch = self.epoch
+        return {
+            "ok": False,
+            "error": "DatabaseError",
+            "message": (
+                f"not primary (epoch {epoch}): this server is a read "
+                "replica — mutations must go to the shard's current primary"
+            ),
+            "not_primary": True,
+            "epoch": epoch,
+        }
+
+    def snapshot_payload(self):
+        """The ``snapshot`` wire op behind ``orion-tpu db backup``: the same
+        consistent full-state dump replica resyncs ship (taken under the
+        replication lock — no mutation interleaves), seq/epoch-stamped so
+        the backup manifest records exactly which position it captured."""
+        with self._repl_lock:
+            return self._snapshot_payload_locked()
 
     def seq_info(self):
-        """The ``seq`` wire op: applied/assigned position + role."""
+        """The ``seq`` wire op: applied/assigned position, role, epoch.
+        A server awaiting a fork repair reports position 0 — it is neither
+        electable nor a valid resume point for entry replay."""
         with self._repl_lock:
-            return {"seq": self.seq, "replica": self._is_replica}
+            return {
+                "seq": 0 if self._resync_pending else self.seq,
+                "replica": self._is_replica,
+                "epoch": self.epoch,
+                "resyncing": self._resync_pending,
+            }
 
     def read_stamp(self):
         """Applied seq to stamp on read replies — replicas only (plain and
@@ -787,12 +1067,16 @@ class DBServer(socketserver.ThreadingTCPServer):
         if not self._is_replica:
             return None
         with self._repl_lock:
-            return self.seq
+            return 0 if self._resync_pending else self.seq
 
     def replication_status(self):
-        """Operator view: position, role, and per-replica acked lag."""
+        """Operator view: position, role, epoch, and per-replica acked lag."""
         with self._repl_lock:
-            status = {"seq": self.seq, "replica": self._is_replica}
+            status = {
+                "seq": self.seq,
+                "replica": self._is_replica,
+                "epoch": self.epoch,
+            }
         status["links"] = [
             {
                 "address": f"{link.host}:{link.port}",
@@ -820,19 +1104,23 @@ class DBServer(socketserver.ThreadingTCPServer):
         return self.seq
 
     def _persist_seq_locked(self):
-        # The meta doc lives in the store so the sequence rides the same
-        # durability the data has (SQLite persist commits it; snapshot mode
-        # pickles it with everything else).
+        # The meta doc lives in the store so the sequence AND epoch ride
+        # the same durability the data has (SQLite persist commits it;
+        # snapshot mode pickles it with everything else).
         db = self._meta_db
-        if not db.write("_replmeta", {"seq": self.seq}, query={"_id": "seq"}):
-            db.write("_replmeta", {"_id": "seq", "seq": self.seq})
+        meta = {"seq": self.seq, "epoch": self.epoch}
+        if not db.write("_replmeta", meta, query={"_id": "seq"}):
+            db.write("_replmeta", dict(meta, _id="seq"))
 
-    def _load_seq(self):
+    def _load_replmeta(self):
+        """``(seq, epoch)`` from the persisted meta doc (0, 0 fresh)."""
         try:
             docs = self._meta_db.read("_replmeta", {"_id": "seq"})
         except Exception:  # pragma: no cover - a fresh store never raises
-            return 0
-        return int(docs[0].get("seq", 0)) if docs else 0
+            return 0, 0
+        if not docs:
+            return 0, 0
+        return int(docs[0].get("seq", 0)), int(docs[0].get("epoch", 0))
 
     def _snapshot_payload_locked(self):
         """Full-state resync payload from a consistent point (the caller
@@ -846,6 +1134,7 @@ class DBServer(socketserver.ThreadingTCPServer):
             collections[name] = db.read(name, {})
         return {
             "seq": self.seq,
+            "epoch": self.epoch,
             "collections": collections,
             "indexes": [list(spec) for spec in db.index_specs()],
         }
@@ -860,6 +1149,7 @@ class DBServer(socketserver.ThreadingTCPServer):
             if docs:
                 db.write(name, docs)
         self.seq = int(snapshot.get("seq", 0))  # lint: disable=LCK002 -- caller holds _repl_lock (_locked contract)
+        self.epoch = int(snapshot.get("epoch", self.epoch))  # lint: disable=LCK002 -- caller holds _repl_lock (_locked contract)
         self._persist_seq_locked()
 
     def _notify_links(self):
@@ -1053,6 +1343,13 @@ def _translate(response, raise_errors=True):
     exc = exc_cls(message) if exc_cls else DatabaseError(f"{error}: {message}")
     if response.get("maybe_applied") and isinstance(exc, DatabaseError):
         exc.maybe_applied = True
+    if response.get("not_primary") and isinstance(exc, DatabaseError):
+        # The server refused a mutation because it is (now) a replica —
+        # the epoch fence's wire form.  Nothing was applied; the sharded
+        # router uses the marker to refresh its view of who the primary is
+        # before the op-level retry re-runs.
+        exc.not_primary = True
+        exc.epoch = int(response.get("epoch", 0) or 0)
     if raise_errors:
         raise exc
     return exc
@@ -1102,6 +1399,9 @@ class NetworkDB:
         #: by a replica).  None until such a response arrives — plain
         #: servers never stamp.  Read via :meth:`seq_snapshot`.
         self.last_seq = None
+        #: Replication epoch stamped next to the seq (promotion protocol);
+        #: None until a stamped response arrives.
+        self.last_epoch = None
         #: Socket send/receive cycles since construction (one per _call,
         #: one per pipeline/batch regardless of op count) — bench.py's
         #: storage breakdown reads this to prove a q-batch round costs O(1)
@@ -1199,7 +1499,12 @@ class NetworkDB:
     # be retried blindly: the server may have applied the request before the
     # reply was lost, and a re-send would double-apply it (a second trial
     # reserved, a spurious DuplicateKeyError on an insert that succeeded).
-    _IDEMPOTENT = frozenset({"read", "count", "index_information", "ping", "seq"})
+    # `snapshot` is a read; `promote` is idempotent by construction (a
+    # resend at the same epoch reports the standing state, never re-flips).
+    _IDEMPOTENT = frozenset(
+        {"read", "count", "index_information", "ping", "seq", "snapshot",
+         "promote"}
+    )
 
     def _exchange(self, payload):
         """One request/response on the current socket; raises on any break.
@@ -1220,17 +1525,28 @@ class NetworkDB:
         return response
 
     def _note_seq(self, response):
-        """Track the replication sequence optionally stamped on a reply
-        (see :attr:`last_seq`).  Callers hold ``_lock``."""
-        seq = response.get("seq") if isinstance(response, dict) else None
+        """Track the replication sequence/epoch optionally stamped on a
+        reply (see :attr:`last_seq`).  Callers hold ``_lock``."""
+        if not isinstance(response, dict):
+            return
+        seq = response.get("seq")
         if seq is not None:
             self.last_seq = int(seq)  # lint: disable=LCK002 -- caller holds _lock
+        epoch = response.get("epoch")
+        if epoch is not None:
+            self.last_epoch = int(epoch)  # lint: disable=LCK002 -- caller holds _lock
 
     def seq_snapshot(self):
         """Thread-safe read of :attr:`last_seq` (the sharded router compares
         a replica's read stamp against its primary's write stamp)."""
         with self._lock:
             return self.last_seq
+
+    def stamp_snapshot(self):
+        """Thread-safe ``(last_seq, last_epoch)`` — the router's fencing
+        check reads both with one lock hold."""
+        with self._lock:
+            return self.last_seq, self.last_epoch
 
     def _probe_idle_connection(self):
         """Ping a connection that has sat idle so a mutation never rides a

@@ -51,16 +51,22 @@ bar is a clean ``orion-tpu audit --all`` on every shard and zero lost
 observations.
 """
 
+import functools
 import hashlib
+import logging
 import threading
+import weakref
 from bisect import bisect_right
 from collections import OrderedDict
 
 from orion_tpu.analysis.sanitizer import TSAN
+from orion_tpu.health import FLIGHT
 from orion_tpu.storage.netdb import NetworkDB
 from orion_tpu.storage.retry import MODE_ALWAYS, create_retry_policy, is_transient
 from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import DatabaseError
+
+log = logging.getLogger(__name__)
 
 #: Virtual nodes per shard on the hash ring.  Enough that removing/adding
 #: one shard moves ~1/N of the keyspace with low variance; small enough
@@ -86,6 +92,81 @@ DEFAULT_SHARD_RETRY = {
 #: Seconds a replica sits out after a transport failure before reads try
 #: it again (connection state is per shard, per replica).
 REPLICA_RETRY_S = 1.0
+
+#: Timeout for election/health ``seq`` probes (dedicated short-lived
+#: connections): a hung node must cost a probe this much, never the data
+#: path's full client timeout.
+PROBE_TIMEOUT_S = 2.0
+
+#: Confirmation window before automatic replica promotion: a shard's
+#: primary must fail CONTINUOUSLY for this long before a router runs an
+#: election.  Long enough to ride out a same-port restart (the soak's
+#: restart_primary takes well under a second); short enough that a dead
+#: box heals inside one op-level retry deadline.
+DEFAULT_PROMOTE_AFTER_S = 1.5
+
+#: Collection holding per-experiment placement override docs (live ring
+#: rebalancing, storage/rebalance.py).  Routers consult it BEFORE the
+#: ring; the docs live on the experiment's RING shard so any router can
+#: find them without knowing the answer.
+PLACEMENT_COLLECTION = "_placement"
+
+#: Seconds a placement lookup (override or its absence) stays cached per
+#: router.  Also the floor a migrator must hold an experiment FENCED
+#: before flipping it: once every router's cache entry has expired, every
+#: router re-reads the override and observes the fence.
+PLACEMENT_TTL_S = 5.0
+
+#: Bounded placement cache (same rationale as the owner cache).
+PLACEMENT_CACHE_CAP = 65536
+
+
+def placement_doc_id(experiment_id):
+    """``_placement`` doc id for one experiment's override."""
+    return f"placement:{experiment_id}"
+
+
+@functools.lru_cache(maxsize=512)
+def _lag_gauge_name(index):
+    """Per-shard gauge names, interned once per index (TEL001: no
+    per-iteration key building on the probe loop)."""
+    return f"netdb.replication.lag.s{index}"
+
+
+@functools.lru_cache(maxsize=512)
+def _epoch_gauge_name(index):
+    return f"netdb.replication.epoch.s{index}"
+
+
+#: Routers registered for replication-lag sampling (the /metrics plane
+#: scrape hook calls :func:`sample_replication_lag`).
+_ROUTER_REGISTRY = weakref.WeakSet()
+_SAMPLE_GATE_LOCK = threading.Lock()
+_last_lag_sample = 0.0
+
+#: Seconds between /metrics-driven replication probes (each probe is one
+#: tiny ``seq`` request per node — cheap, but a hot scrape loop must not
+#: turn it into load).
+LAG_SAMPLE_INTERVAL_S = 5.0
+
+
+def sample_replication_lag(force=False):
+    """Publish ``netdb.replication.lag.s{i}`` / ``.epoch.s{i}`` gauges for
+    every live router (rate-limited).  Called from the /metrics scrape
+    path; never raises — metrics must not break serving."""
+    global _last_lag_sample
+    import time as _time
+
+    now = _time.monotonic()
+    with _SAMPLE_GATE_LOCK:
+        if not force and now - _last_lag_sample < LAG_SAMPLE_INTERVAL_S:
+            return
+        _last_lag_sample = now
+    for router in list(_ROUTER_REGISTRY):
+        try:
+            router.replication_health()
+        except Exception:  # pragma: no cover - observability never raises
+            log.debug("replication lag sample failed", exc_info=True)
 
 
 def merge_maybe_applied(errors):
@@ -223,6 +304,12 @@ class _Shard:
             NetworkDB(host=h, port=p, **client_kwargs)
             for h, p in spec.get("replicas") or ()
         ]
+        #: The replica addresses this shard was CONFIGURED with (identity
+        #: comparison for live topology swaps — the live ``replicas`` list
+        #: reorders on promotion).
+        self.replica_addrs = frozenset(
+            f"{h}:{int(p)}" for h, p in spec.get("replicas") or ()
+        )
         self.policy = policy
         self._lock = threading.Lock()
         self._write_floor = 0
@@ -232,26 +319,113 @@ class _Shard:
         #: ``storage.shard.s{i}.failovers`` / ``.replica_stale_reads``.
         self.failovers = 0
         self.replica_stale_reads = 0
+        #: Promotion state: the highest replication epoch this router ever
+        #: saw from this shard (the fencing floor), the monotonic start of
+        #: the current consecutive primary-failure streak, and a guard so
+        #: one thread per router runs an election at a time.
+        self._epoch = 0
+        self._fail_since = None
+        self._promote_guard = threading.Lock()
+        self.promotions = 0
 
     @property
     def identity(self):
+        """The shard's STABLE ring identity: the address of its original
+        primary.  Never changes on promotion — the ring (and therefore
+        experiment placement) must not move because a replica took over."""
         return f"{self.host}:{self.port}"
 
     @property
     def reconnects(self):
         return self.primary.reconnects + sum(r.reconnects for r in self.replicas)
 
-    def note_write(self):
+    def note_write(self, client=None):
         """Raise the staleness floor to the primary's latest stamped seq
         (replicating primaries stamp mutating replies; plain ones never do,
-        and the floor stays 0 = every replica read is acceptable)."""
-        seq = self.primary.seq_snapshot()
-        if seq is None:
+        and the floor stays 0 = every replica read is acceptable), and the
+        epoch floor to its stamped epoch.  Returns True when the reply came
+        from a LOWER epoch than this router has already seen on the shard —
+        a stale primary the caller must fence (the write landed on a
+        condemned fork that the promoted timeline will erase).
+        ``client`` pins the stamp to the connection the mutation actually
+        rode: a concurrent promotion may swap ``self.primary`` between the
+        call and this check, and the swapped-in client's stamp would miss
+        exactly the stale-epoch reply the fence exists to catch."""
+        seq, epoch = (client or self.primary).stamp_snapshot()
+        stale = False
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            if epoch is not None:
+                if epoch < self._epoch:
+                    stale = True
+                elif epoch > self._epoch:
+                    self._epoch = epoch
+            if not stale and seq is not None and seq > self._write_floor:
+                self._write_floor = seq
+        return stale
+
+    def note_epoch(self, epoch):
+        """Lift the epoch floor (a not-primary refusal or probe reported a
+        newer epoch than any stamped reply so far)."""
+        if not epoch:
             return
         with self._lock:
             TSAN.write("ShardedNetworkDB._shard_state", self)
-            if seq > self._write_floor:
-                self._write_floor = seq
+            if epoch > self._epoch:
+                self._epoch = epoch
+
+    def epoch_floor(self):
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            return self._epoch
+
+    # --- primary failure detection / promotion ------------------------------
+    def note_primary_failure(self, now):
+        """Mark one failed primary op; the streak starts at the FIRST
+        consecutive failure and clears on any success."""
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            if self._fail_since is None:
+                self._fail_since = now
+
+    def clear_primary_failure(self):
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            self._fail_since = None
+
+    def failing_for(self, now):
+        """Seconds the primary has been failing continuously (0 if healthy)."""
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            return 0.0 if self._fail_since is None else now - self._fail_since
+
+    def promote_swap(self, replica_index, epoch, now):
+        """Swap the shard's primary client for the promoted replica's; the
+        old primary client takes the replica's slot (briefly benched — when
+        the dead box is reborn it comes back demoted, a legitimate read
+        replica).  The shard's ring identity does NOT change."""
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            winner = self.replicas[replica_index]
+            self.replicas[replica_index] = self.primary
+            self.primary = winner
+            self._down_until[replica_index] = now + REPLICA_RETRY_S
+            if epoch > self._epoch:
+                self._epoch = epoch
+            self._fail_since = None
+            self.promotions += 1
+        return winner
+
+    def promote_in_place(self, epoch):
+        """The primary-slot client itself won the election (a promoted
+        node that restarted back into its configured replica role): no
+        swap, just the epoch/streak/counter bookkeeping."""
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            if epoch > self._epoch:
+                self._epoch = epoch
+            self._fail_since = None
+            self.promotions += 1
 
     def write_floor(self):
         with self._lock:
@@ -317,25 +491,44 @@ class ShardedNetworkDB:
         reconnect_jitter=0.1,
         shard_retry=None,
         replica_reads=True,
+        auto_promote=True,
+        promote_after=DEFAULT_PROMOTE_AFTER_S,
+        placement_ttl=PLACEMENT_TTL_S,
     ):
         specs = parse_shard_specs(shards, default_secret=secret)
-        client_base = {
+        self._client_base = {
             "timeout": timeout,
             "idle_probe": idle_probe,
             "reconnect_jitter": reconnect_jitter,
         }
-        retry_config = (
+        self._default_secret = secret
+        self._retry_config = (
             dict(DEFAULT_SHARD_RETRY) if shard_retry is None else shard_retry
         )
-        self._shards = []
-        for index, spec in enumerate(specs):
+        #: Automatic replica promotion: after ``promote_after`` seconds of
+        #: continuous primary failure, elect the most-caught-up replica
+        #: (deterministic: highest seq, address tie-break — concurrent
+        #: routers converge on the SAME winner).
+        self.auto_promote = bool(auto_promote)
+        self.promote_after = float(promote_after)
+        #: Placement-override lookup cache TTL (0 disables overrides —
+        #: single-topology deployments that never rebalance).
+        self.placement_ttl = float(placement_ttl)
+        self._topology_lock = threading.Lock()
+        self._shards = [
             # Each shard gets its OWN policy instance: independent jitter
             # streams and deadlines, so one shard's outage never consumes
             # another's retry budget.
-            policy = create_retry_policy(retry_config)
-            kwargs = dict(client_base, secret=spec.get("secret"))
-            self._shards.append(_Shard(index, spec, kwargs, policy))
+            _Shard(
+                index,
+                spec,
+                dict(self._client_base, secret=spec.get("secret")),
+                create_retry_policy(self._retry_config),
+            )
+            for index, spec in enumerate(specs)
+        ]
         self._ring = HashRing([s.identity for s in self._shards], vnodes=vnodes)
+        self._identity_index = {s.identity: s.index for s in self._shards}
         self.replica_reads = bool(replica_reads)
         #: Pure pass-through mode: one shard, no replicas — every op
         #: delegates verbatim to the single NetworkDB (bit-identical wire
@@ -345,20 +538,37 @@ class ShardedNetworkDB:
         )
         self._owner_lock = threading.Lock()
         self._owners = OrderedDict()  # (collection, _id) -> shard index
+        self._placement_lock = threading.Lock()
+        #: experiment key -> (shard identity or None, state, expires_at).
+        self._placements = OrderedDict()
         self._stats_lock = threading.Lock()
         self.fan_outs = 0
         self._monotonic = None  # injectable clock for tests
+        self._register_shard_counters()
+        _ROUTER_REGISTRY.add(self)
+
+    _SHARD_COUNTER_ATTRS = (
+        "reconnects", "failovers", "replica_stale_reads", "promotions",
+    )
+
+    def _register_shard_counters(self):
         for shard in self._shards:
             prefix = f"storage.shard.s{shard.index}"
-            TELEMETRY.register_external_counter(
-                f"{prefix}.reconnects", shard, "reconnects"
-            )
-            TELEMETRY.register_external_counter(
-                f"{prefix}.failovers", shard, "failovers"
-            )
-            TELEMETRY.register_external_counter(
-                f"{prefix}.replica_stale_reads", shard, "replica_stale_reads"
-            )
+            for attr in self._SHARD_COUNTER_ATTRS:
+                TELEMETRY.register_external_counter(
+                    f"{prefix}.{attr}", shard, attr
+                )
+
+    def _unregister_shard_counters(self, shards):
+        """Drop ``shards``' registrations at their CURRENT indices — run
+        before a topology change reindexes/removes them, or a surviving
+        shard would keep exporting under its old ``s{i}`` name too."""
+        for shard in shards:
+            prefix = f"storage.shard.s{shard.index}"
+            for attr in self._SHARD_COUNTER_ATTRS:
+                TELEMETRY.unregister_external_counter(
+                    f"{prefix}.{attr}", shard
+                )
 
     # --- aggregate counters (DocumentStorage re-exports these) ---------------
     @property
@@ -387,6 +597,10 @@ class ShardedNetworkDB:
     def replica_stale_reads(self):
         return sum(s.replica_stale_reads for s in self._shards)
 
+    @property
+    def promotions(self):
+        return sum(s.promotions for s in self._shards)
+
     # --- topology surface (CLI: db ring, audit, info) ------------------------
     @property
     def n_shards(self):
@@ -403,6 +617,9 @@ class ShardedNetworkDB:
                     "index": s.index,
                     "address": s.identity,
                     "replicas": [f"{r.host}:{r.port}" for r in s.replicas],
+                    "primary": f"{s.primary.host}:{s.primary.port}",
+                    "epoch": s.epoch_floor(),
+                    "promotions": s.promotions,
                 }
                 for s in self._shards
             ],
@@ -410,12 +627,142 @@ class ShardedNetworkDB:
             "replica_reads": self.replica_reads,
         }
 
+    def replication_health(self):
+        """Probe every shard node's ``seq`` op: per-shard epoch, primary
+        position, per-replica applied position and lag (primary − replica).
+        Publishes the ``netdb.replication.lag.s{i}`` / ``.epoch.s{i}``
+        gauges the /metrics plane exports; ``orion-tpu top --all`` and
+        ``info --all`` render the same structure in their topology
+        headers.  Probes are tiny one-line requests, run CONCURRENTLY per
+        shard (a dark, partitioned shard costs the whole view one
+        PROBE_TIMEOUT_S, never a stall per node); a dead node reports an
+        ``error`` instead of failing the whole view."""
+        shards = list(self._shards)
+        health = [None] * len(shards)
+
+        def probe_shard(slot, shard):
+            entry = {
+                "index": shard.index,
+                "address": shard.identity,
+                "primary": f"{shard.primary.host}:{shard.primary.port}",
+                "replicas": [],
+            }
+            primary_seq = None
+            try:
+                info = self._probe_seq(shard.primary)
+            except Exception as exc:
+                entry["error"] = f"{type(exc).__name__}: {exc}"
+            else:
+                primary_seq = int(info.get("seq", 0))
+                entry["seq"] = primary_seq
+                entry["epoch"] = int(info.get("epoch", 0) or 0)
+                entry["role"] = "replica" if info.get("replica") else "primary"
+                shard.note_epoch(entry["epoch"])
+            lags = []
+            for replica in shard.replicas:
+                row = {"address": f"{replica.host}:{replica.port}"}
+                try:
+                    info = self._probe_seq(replica)
+                except Exception as exc:
+                    row["error"] = f"{type(exc).__name__}: {exc}"
+                else:
+                    row["seq"] = int(info.get("seq", 0))
+                    row["epoch"] = int(info.get("epoch", 0) or 0)
+                    if info.get("resyncing"):
+                        row["resyncing"] = True
+                    if primary_seq is not None:
+                        row["lag"] = max(0, primary_seq - row["seq"])
+                        lags.append(row["lag"])
+                entry["replicas"].append(row)
+            entry["max_lag"] = max(lags) if lags else None
+            health[slot] = entry
+
+        if len(shards) == 1:
+            probe_shard(0, shards[0])
+        else:
+            threads = [
+                threading.Thread(
+                    target=probe_shard, args=(slot, shard), daemon=True
+                )
+                for slot, shard in enumerate(shards)
+            ]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join()
+        if TELEMETRY.enabled:
+            for entry, shard in zip(health, shards):
+                # Interned per-index names (lru_cache): no per-iteration
+                # key building, same discipline as devmem's bucket gauges.
+                lag_name = _lag_gauge_name(shard.index)
+                epoch_name = _epoch_gauge_name(shard.index)
+                if entry["max_lag"] is not None:
+                    TELEMETRY.set_gauge(lag_name, entry["max_lag"])
+                if entry.get("epoch") is not None:
+                    TELEMETRY.set_gauge(epoch_name, entry.get("epoch", 0))
+        return health
+
     def shard_connections(self):
         """``[(index, primary NetworkDB), ...]`` — the per-shard direct
         surface the soak/audit tooling uses to verify every shard alone."""
         return [(s.index, s.primary) for s in self._shards]
 
+    def set_topology(self, shards, vnodes=None):
+        """Rebuild the ring and shard set IN PLACE for a new topology —
+        the router half of live rebalancing (``orion-tpu db rebalance``).
+
+        Shards whose identity (original primary ``host:port``) AND replica
+        set survive keep their connections, counters, epoch floors and
+        failure state; new shards connect fresh; removed (or reshaped)
+        shards close.  The owner and placement caches reset — both may
+        point across the topology change.  Ops in flight on a removed
+        shard fail transiently and re-route through the new ring on their
+        op-level retry."""
+        specs = parse_shard_specs(shards, default_secret=self._default_secret)
+        with self._topology_lock:
+            # Old-name registrations go first: a surviving shard may land
+            # on a NEW index and must not keep exporting under the old one.
+            self._unregister_shard_counters(self._shards)
+            old = {s.identity: s for s in self._shards}
+            rebuilt = []
+            for index, spec in enumerate(specs):
+                identity = f"{spec['host']}:{int(spec['port'])}"
+                survivor = old.get(identity)
+                replica_addrs = frozenset(
+                    f"{h}:{int(p)}" for h, p in spec.get("replicas") or ()
+                )
+                if survivor is not None and survivor.replica_addrs == replica_addrs:
+                    del old[identity]
+                    survivor.index = index
+                    rebuilt.append(survivor)
+                else:
+                    rebuilt.append(
+                        _Shard(
+                            index,
+                            spec,
+                            dict(self._client_base, secret=spec.get("secret")),
+                            create_retry_policy(self._retry_config),
+                        )
+                    )
+            self._shards = rebuilt
+            self._ring = HashRing(
+                [s.identity for s in rebuilt],
+                vnodes=self._ring.vnodes if vnodes is None else vnodes,
+            )
+            self._identity_index = {s.identity: s.index for s in rebuilt}
+            self._passthrough = len(rebuilt) == 1 and not rebuilt[0].replicas
+            with self._owner_lock:
+                TSAN.write("ShardedNetworkDB._owners", self)
+                self._owners.clear()
+            with self._placement_lock:
+                TSAN.write("ShardedNetworkDB._placements", self)
+                self._placements.clear()
+            self._register_shard_counters()
+        for shard in old.values():
+            shard.close()
+
     def close(self):
+        _ROUTER_REGISTRY.discard(self)
         for shard in self._shards:
             shard.close()
 
@@ -431,21 +778,23 @@ class ShardedNetworkDB:
         """Shard index for a doc/query, or None (fan out).  Experiments
         route by their own ``_id``; everything else routes by the
         ``experiment`` field, falling back to the owner cache for id-only
-        queries and to the id's own ring point for id-carrying docs."""
+        queries and to the id's own ring point for id-carrying docs.
+        Experiment-keyed routes consult the per-experiment placement
+        override (live rebalancing) before the ring."""
         if collection == "experiments":
             key = None
             if query is not None:
                 key = _concrete(query.get("_id"))
             if key is None and doc is not None:
                 key = _concrete(doc.get("_id"))
-            return None if key is None else self._ring.lookup(str(key))
+            return None if key is None else self._placed_index(str(key))
         exp = None
         if query is not None:
             exp = _concrete(query.get("experiment"))
         if exp is None and doc is not None:
             exp = _concrete(doc.get("experiment"))
         if exp is not None:
-            return self._ring.lookup(str(exp))
+            return self._placed_index(str(exp))
         if doc is not None:
             _id = _concrete(doc.get("_id"))
             if _id is not None:
@@ -455,6 +804,118 @@ class ShardedNetworkDB:
             if _id is not None:
                 return self._owner_of(collection, _id)
         return None
+
+    def _shard_at(self, index):
+        """Indexed shard access that tolerates a concurrent
+        :meth:`set_topology`: the ring and the shard list are swapped in
+        two assignments, so an op that routed against the OLD ring may
+        briefly hold an index past the NEW list.  Surface it as the
+        transient it is — the op-level retry re-routes through the new
+        ring — instead of an IndexError no retry policy classifies."""
+        shards = self._shards
+        if index >= len(shards):
+            error = DatabaseError(
+                f"shard index {index} routed against a topology of "
+                f"{len(shards)} shard(s) — the ring changed mid-route; "
+                "retrying re-routes"
+            )
+            error.maybe_applied = merge_maybe_applied(())
+            raise error
+        return shards[index]
+
+    # --- placement overrides (live rebalancing) ------------------------------
+    def _placed_index(self, key):
+        """Ring placement with the per-experiment override consulted first.
+
+        The override doc lives on the experiment's RING shard (the one
+        place any router can find without knowing the answer); a cached
+        lookup costs a dict read, a miss costs one tiny primary read that
+        is then cached for :attr:`placement_ttl` seconds.  A FENCED
+        experiment (mid-flip migration window) raises a transient error —
+        the op-level retry re-routes after the flip."""
+        ring_index = self._ring.lookup(key)
+        if self.placement_ttl <= 0 or self._passthrough:
+            return ring_index
+        entry = self._placement_cached(key)
+        if entry is None:
+            entry = self._placement_read(key, ring_index)
+        identity, state = entry
+        if state == "fenced":
+            error = DatabaseError(
+                f"experiment {key} is fenced mid-migration "
+                "(placement flip in progress); the op will re-route on retry"
+            )
+            # Pre-flight refusal: the op never ran anywhere.
+            error.maybe_applied = merge_maybe_applied(())
+            raise error
+        if identity is None:
+            return ring_index
+        index = self._identity_index.get(identity)
+        if index is None:
+            # The override names a shard this topology doesn't carry —
+            # a half-rolled-out topology change.  The ring is the best
+            # remaining answer; say so once per TTL (the cache holds it).
+            log.warning(
+                "placement override for %s names unknown shard %s; "
+                "falling back to the ring", key, identity,
+            )
+            return ring_index
+        return index
+
+    def _placement_cached(self, key):
+        now = self._now()
+        with self._placement_lock:
+            TSAN.write("ShardedNetworkDB._placements", self)
+            entry = self._placements.get(key)
+            if entry is None or entry[2] <= now:
+                return None
+            return entry[0], entry[1]
+
+    def _placement_read(self, key, ring_index):
+        try:
+            docs = self._shard_at(ring_index).primary.read(
+                PLACEMENT_COLLECTION, {"_id": placement_doc_id(key)}
+            )
+        except Exception:
+            # The ring shard is unreachable: route by the ring — the op
+            # itself will surface (and retry) the outage through its own
+            # path; a placement probe must not add a second failure mode.
+            return None, None
+        doc = docs[0] if docs else None
+        identity = doc.get("shard") if doc else None
+        state = doc.get("state") if doc else None
+        if state == "fenced":
+            # Never cached: re-read until the migrator flips it.
+            return identity, state
+        with self._placement_lock:
+            TSAN.write("ShardedNetworkDB._placements", self)
+            placements = self._placements
+            placements[key] = (identity, state, self._now() + self.placement_ttl)
+            placements.move_to_end(key)
+            while len(placements) > PLACEMENT_CACHE_CAP:
+                placements.popitem(last=False)
+        return identity, state
+
+    def _invalidate_placement(self, collection, query):
+        """Drop the placement cache entry behind an empty ROUTED answer —
+        but only when an override (not the ring) routed it: a router whose
+        cache still points at a migrated-away source would otherwise keep
+        reading deleted ground until the TTL expired.  Ring-routed empties
+        (a fresh experiment with no trials yet) invalidate nothing, so the
+        hot status-poll path never pays an extra probe."""
+        key = None
+        if query is not None:
+            if collection == "experiments":
+                key = _concrete(query.get("_id"))
+            else:
+                key = _concrete(query.get("experiment"))
+        if key is None:
+            return
+        with self._placement_lock:
+            TSAN.write("ShardedNetworkDB._placements", self)
+            entry = self._placements.get(str(key))
+            if entry is not None and entry[0] is not None:
+                del self._placements[str(key)]
 
     def _owner_of(self, collection, _id):
         with self._owner_lock:
@@ -561,14 +1022,258 @@ class ShardedNetworkDB:
                     # floor it read).  Re-read from the primary.
                     shard.note_stale()
                     TELEMETRY.count("storage.shard.replica_stale_reads")
-        return getattr(shard.primary, op)(*args, **kwargs)
+        try:
+            # Reads never CLEAR the failure streak: a demoted node serves
+            # reads happily while refusing every mutation — read successes
+            # resetting the streak would starve the re-election the
+            # refusals are feeding.
+            return getattr(shard.primary, op)(*args, **kwargs)
+        except Exception as exc:
+            self._note_primary_error(shard, exc)
+            raise
 
     def _shard_mutate(self, shard, op, *args, **kwargs):
         """One mutation on one shard's PRIMARY; lifts the staleness floor
-        from the stamped reply."""
-        result = getattr(shard.primary, op)(*args, **kwargs)
-        shard.note_write()
+        from the stamped reply.  Failures feed the promotion detector;
+        a reply stamped with a LOWER epoch than this router has seen is
+        FENCED — refused after the fact, because it landed on a stale
+        primary whose fork the promoted timeline will erase."""
+        # Capture the client once: a concurrent promotion may swap
+        # shard.primary mid-call, and the fence below must stamp-check the
+        # connection this op actually rode.
+        primary = shard.primary
+        try:
+            result = getattr(primary, op)(*args, **kwargs)
+        except Exception as exc:
+            self._note_primary_error(shard, exc)
+            raise
+        # Fence BEFORE clearing the failure streak: a wire-successful write
+        # answered from a stale epoch is a FAILURE of the shard (it landed
+        # on a condemned fork), and repeated fenced writes must accumulate
+        # the same streak dead sockets do — that streak is the only road
+        # back to an election when a stale claimant is the one answering.
+        self._fence_stale_write(shard, primary, op)
+        shard.clear_primary_failure()
         return result
+
+    def _fence_stale_write(self, shard, primary, op):
+        """Raise when the mutating reply on ``primary`` carried a LOWER
+        epoch than this router has seen on the shard."""
+        if not shard.note_write(primary):
+            return
+        shard.note_primary_failure(self._now())
+        TELEMETRY.count("storage.shard.fenced_writes")
+        error = DatabaseError(
+            f"shard {shard.index} answered {op!r} from a stale epoch "
+            f"(below {shard.epoch_floor()}): the write landed on a "
+            "demoting primary and will not survive its resync — "
+            "retrying against the promoted primary"
+        )
+        # The stale primary DID apply it, but that application is a
+        # condemned fork the next resync erases: in the surviving
+        # timeline nothing was applied, so the op-level retry must
+        # re-run it (maybe_applied=True would make non-converging ops
+        # give up and lose the write for real).
+        error.maybe_applied = merge_maybe_applied(())
+        self._refresh_shard_primary(shard)
+        raise error
+
+    def _note_primary_error(self, shard, exc):
+        """Feed one failed primary op into the promotion detector."""
+        now = self._now()
+        if getattr(exc, "not_primary", False):
+            # The node we call primary answered as a REPLICA.  Usually a
+            # concurrent router promoted — adopt its winner.  But when
+            # NOBODY claims primary (the promoted node itself restarted
+            # back into its configured replica role), adoption finds
+            # nothing — so the refusals feed the same confirmation window
+            # and a real election re-promotes the caught-up node IN PLACE.
+            shard.note_epoch(getattr(exc, "epoch", 0))
+            shard.note_primary_failure(now)
+            if (
+                self.auto_promote
+                and shard.failing_for(now) >= self.promote_after
+            ):
+                self._run_election(shard)
+            else:
+                self._refresh_shard_primary(shard)
+            return
+        if not is_transient(exc):
+            return
+        shard.note_primary_failure(now)
+        if (
+            self.auto_promote
+            and shard.replicas
+            and shard.failing_for(now) >= self.promote_after
+        ):
+            self._run_election(shard)
+
+    def _refresh_shard_primary(self, shard):
+        """Re-discover which node serves the shard (post-promotion or
+        post-fence): probe all nodes, adopt whichever claims primary at
+        the highest epoch.  Never elects — that needs the confirmation
+        window; this only catches up with an election someone else ran."""
+        if not shard.replicas:
+            return
+        if not shard._promote_guard.acquire(blocking=False):
+            return  # someone on this router is already sorting it out
+        try:
+            self._elect(shard, adopt_only=True)
+        finally:
+            shard._promote_guard.release()
+
+    def _run_election(self, shard):
+        """Confirmation window expired: elect and promote (one thread per
+        router at a time; concurrent routers converge via the
+        deterministic winner + the idempotent promote op)."""
+        if not shard._promote_guard.acquire(blocking=False):
+            return
+        try:
+            # Re-check under the guard: a concurrent thread may have just
+            # promoted and cleared the streak.
+            if shard.failing_for(self._now()) < self.promote_after:
+                return
+            self._elect(shard, adopt_only=False)
+        finally:
+            shard._promote_guard.release()
+
+    def _probe_seq(self, client):
+        """One ``seq`` probe over a short-lived, SHORT-timeout connection:
+        elections and health views must not borrow the data path's (long)
+        timeout or contend its connection lock — a hung node costs at
+        most PROBE_TIMEOUT_S here, not a data-timeout-long stall."""
+        probe = NetworkDB(
+            host=client.host, port=client.port, timeout=PROBE_TIMEOUT_S,
+            secret=client.secret, reconnect_jitter=0,
+        )
+        try:
+            return probe._call("seq") or {}
+        finally:
+            probe.close()
+
+    def _elect(self, shard, adopt_only):
+        max_epoch = shard.epoch_floor()
+        candidates = []
+        promoted_elsewhere = None
+        # The primary slot gets one last probe: a box that answers AS A
+        # PRIMARY mid-window was merely slow/restarting — no election.
+        # One that answers as a REPLICA is itself the leading candidate:
+        # a previously promoted node that restarted back into its
+        # configured replica role sits in this slot precisely because an
+        # earlier election chose it (its seq decides like any other's).
+        floor = shard.epoch_floor()
+        try:
+            info = self._probe_seq(shard.primary)
+        except Exception:
+            pass
+        else:
+            epoch = int(info.get("epoch", 0) or 0)
+            max_epoch = max(max_epoch, epoch)
+            if not info.get("replica"):
+                if epoch >= floor:
+                    shard.note_epoch(epoch)
+                    shard.clear_primary_failure()
+                    return
+                # A primary claimant BELOW this router's epoch floor is a
+                # stale fork that never heard of its own demotion (its
+                # newer-epoch peer may be dead too).  Never honored, never
+                # electable: blessing the fork would silently discard the
+                # newer timeline — that trade-off belongs to an operator.
+            elif not info.get("resyncing") and epoch >= floor:
+                # Electable only at/above the floor: a replica still on an
+                # OLDER epoch missed writes a newer primary acknowledged.
+                candidates.append(
+                    (int(info.get("seq", 0)), shard.primary, None)
+                )
+        for index, replica in enumerate(shard.replicas):
+            try:
+                info = self._probe_seq(replica)
+            except Exception:
+                continue  # unreachable: not electable from here
+            epoch = int(info.get("epoch", 0) or 0)
+            max_epoch = max(max_epoch, epoch)
+            if not info.get("replica"):
+                # Already a primary (a concurrent router won the race):
+                # adopt the highest-epoch claimant — but never one BELOW
+                # the epoch floor (a stale fork, see above).
+                if epoch >= floor and (
+                    promoted_elsewhere is None or epoch > promoted_elsewhere[1]
+                ):
+                    promoted_elsewhere = (index, epoch)
+                continue
+            if info.get("resyncing") or epoch < floor:
+                # A fork mid-repair, or a replica still on an older epoch
+                # (it missed writes a newer primary acknowledged): not
+                # electable.
+                continue
+            candidates.append((int(info.get("seq", 0)), replica, index))
+        if promoted_elsewhere is not None:
+            index, epoch = promoted_elsewhere
+            self._adopt_primary(shard, index, epoch, elected=False)
+            return
+        if adopt_only or not candidates:
+            return
+        # Deterministic winner: most caught-up wins; ties break on address
+        # so every router probing the same fleet elects the SAME replica.
+        candidates.sort(key=lambda c: (-c[0], f"{c[1].host}:{c[1].port}"))
+        seq, winner, index = candidates[0]
+        winner_addr = f"{winner.host}:{winner.port}"
+        peers = [
+            addr
+            for addr in [shard.identity]
+            + [f"{r.host}:{r.port}" for r in shard.replicas]
+            if addr != winner_addr
+        ]
+        new_epoch = max_epoch + 1
+        try:
+            # Rides the shard policy with an explicit mode (STO005): the
+            # promote op is idempotent by construction — a resend at the
+            # same epoch reports the standing state, never re-flips.
+            result = shard.policy.run(
+                lambda: winner._call(
+                    "promote", {"epoch": new_epoch, "replicate_to": peers}
+                ),
+                op=f"shard.s{shard.index}.promote",
+                mode=MODE_ALWAYS,
+            ) or {}
+        except Exception as exc:
+            log.warning(
+                "promotion of %s:%s (shard %d) failed: %s",
+                winner.host, winner.port, shard.index, exc,
+            )
+            return
+        if not result.get("primary"):
+            # Lost a cross-router race (the winner already heard a higher
+            # epoch as a replica) — the next failure cycle adopts.
+            shard.note_epoch(int(result.get("epoch", 0) or 0))
+            return
+        self._adopt_primary(
+            shard, index, int(result.get("epoch", new_epoch)), elected=True
+        )
+
+    def _adopt_primary(self, shard, replica_index, epoch, elected):
+        if replica_index is None:
+            winner = shard.primary
+            shard.promote_in_place(epoch)
+        else:
+            winner = shard.promote_swap(replica_index, epoch, self._now())
+        TELEMETRY.count("storage.shard.promotions")
+        if FLIGHT.enabled:
+            FLIGHT.record(
+                "promote",
+                args={
+                    "shard": shard.index,
+                    "winner": f"{winner.host}:{winner.port}",
+                    "epoch": epoch,
+                    "elected": elected,
+                },
+            )
+        log.warning(
+            "shard %d: %s %s:%s as primary at epoch %d (was %s)",
+            shard.index,
+            "promoted" if elected else "adopted",
+            winner.host, winner.port, epoch, shard.identity,
+        )
 
     # --- AbstractDB contract -------------------------------------------------
     def ping(self):
@@ -584,8 +1289,10 @@ class ShardedNetworkDB:
             return self._shards[0].primary.ensure_index(
                 collection, keys, unique=unique
             )
-        self._each_shard(
-            lambda shard: shard.primary.ensure_index(collection, keys, unique=unique),
+        self._ensure_through_promotion(
+            lambda shard: self._shard_mutate(
+                shard, "ensure_index", collection, keys, unique=unique
+            ),
             op="ensure_index",
         )
 
@@ -593,9 +1300,33 @@ class ShardedNetworkDB:
         if self._passthrough:
             return self._shards[0].primary.ensure_indexes(specs)
         specs = [list(s) for s in specs]
-        self._each_shard(
-            lambda shard: shard.primary.ensure_indexes(specs), op="ensure_indexes"
+        self._ensure_through_promotion(
+            lambda shard: self._shard_mutate(shard, "ensure_indexes", specs),
+            op="ensure_indexes",
         )
+
+    def _ensure_through_promotion(self, leg, op):
+        """Index setup runs at CONSTRUCTION time — before any op has fed
+        the failure detector — so a dead primary would otherwise crash
+        every fresh process (CLI command, new worker) even though a
+        caught-up replica is one election away.  Re-run the fan-out
+        (idempotent) long enough for the per-leg failures to accumulate a
+        promotion streak and for the election to heal the shard; a shard
+        that stays dead past the window raises exactly as before."""
+        import time
+
+        deadline = (
+            self._now() + self.promote_after * 2 + 2.0
+            if self.auto_promote
+            else None
+        )
+        while True:
+            try:
+                return self._each_shard(leg, op=op)
+            except DatabaseError:
+                if deadline is None or self._now() >= deadline:
+                    raise
+                time.sleep(0.2)
 
     def index_information(self, collection):
         if self._passthrough:
@@ -635,7 +1366,7 @@ class ShardedNetworkDB:
             index = self._route(collection, query=query)
             if index is not None:
                 return self._shard_mutate(
-                    self._shards[index], "write", collection, data, query=query
+                    self._shard_at(index), "write", collection, data, query=query
                 )
             results = self._each_shard(
                 lambda shard: self._shard_mutate(
@@ -665,14 +1396,14 @@ class ShardedNetworkDB:
             # shape (the inserted id, minted or server-assigned).
             (index, members), = groups.items()
             doc = members[0][1]
-            result = self._shard_mutate(self._shards[index], "write", collection, doc)
+            result = self._shard_mutate(self._shard_at(index), "write", collection, doc)
             self._remember_owner(collection, doc.get("_id"), index)
             return result
         out = [None] * len(docs)
         for index, members in groups.items():
             payload = [doc for _, doc in members]
             ids = self._shard_mutate(
-                self._shards[index], "write", collection, payload
+                self._shard_at(index), "write", collection, payload
             )
             for (position, doc), _id in zip(members, ids):
                 out[position] = _id
@@ -700,7 +1431,7 @@ class ShardedNetworkDB:
         total = 0
         for index, shard_pairs in routed.items():
             total += self._shard_mutate(
-                self._shards[index], "update_many", collection, shard_pairs
+                self._shard_at(index), "update_many", collection, shard_pairs
             )
         if broadcast:
             # Un-keyed updates apply to matching docs WHEREVER they live —
@@ -722,10 +1453,14 @@ class ShardedNetworkDB:
         index = self._route(collection, query=query)
         if index is not None:
             docs = self._shard_read(
-                self._shards[index], "read", collection, query=query,
+                self._shard_at(index), "read", collection, query=query,
                 projection=projection,
             )
             self._harvest_owners(collection, docs, index)
+            if not docs:
+                # Invalidated-on-miss: an override-routed empty answer may
+                # mean the experiment moved on (post-delete stale cache).
+                self._invalidate_placement(collection, query)
             return docs
         merged = []
         results = self._each_shard(
@@ -745,9 +1480,12 @@ class ShardedNetworkDB:
             return self._shards[0].primary.count(collection, query=query)
         index = self._route(collection, query=query)
         if index is not None:
-            return self._shard_read(
-                self._shards[index], "count", collection, query=query
+            result = self._shard_read(
+                self._shard_at(index), "count", collection, query=query
             )
+            if not result:
+                self._invalidate_placement(collection, query)
+            return result
         results = self._each_shard(
             lambda shard: self._shard_read(shard, "count", collection, query=query),
             read_only=True,
@@ -761,10 +1499,12 @@ class ShardedNetworkDB:
         index = self._route(collection, query=query)
         if index is not None:
             doc = self._shard_mutate(
-                self._shards[index], "read_and_write", collection, query, data
+                self._shard_at(index), "read_and_write", collection, query, data
             )
             if isinstance(doc, dict):
                 self._remember_owner(collection, doc.get("_id"), index)
+            else:
+                self._invalidate_placement(collection, query)
             return doc
         if _concrete((query or {}).get("_id")) is None:
             # A find-ONE-and-update keyed by neither _id nor experiment has
@@ -779,9 +1519,13 @@ class ShardedNetworkDB:
             error.maybe_applied = merge_maybe_applied(())
             raise error
         # Id-only owner-cache miss: ids are globally unique, so at most
-        # ONE shard matches; the others no-op to None.
+        # ONE shard matches; the others no-op to None.  Each leg rides
+        # _shard_mutate so failures feed the promotion detector and the
+        # fence stamps the connection the CAS actually rode.
         results, errors = self._collect_shards(
-            lambda shard: shard.primary.read_and_write(collection, query, data),
+            lambda shard: self._shard_mutate(
+                shard, "read_and_write", collection, query, data
+            ),
             op="read_and_write",
         )
         winner = None
@@ -789,7 +1533,6 @@ class ShardedNetworkDB:
             if isinstance(doc, dict):
                 winner = doc
                 self._remember_owner(collection, doc.get("_id"), shard.index)
-                shard.note_write()
         failed = [e for e in errors if e is not None]
         if winner is not None:
             # The unique-id invariant (the query carries a concrete _id,
@@ -811,7 +1554,7 @@ class ShardedNetworkDB:
         index = self._route(collection, query=query)
         if index is not None:
             return self._shard_mutate(
-                self._shards[index], "remove", collection, query=query
+                self._shard_at(index), "remove", collection, query=query
             )
         results = self._each_shard(
             lambda shard: self._shard_mutate(shard, "remove", collection, query=query),
@@ -875,15 +1618,26 @@ class ShardedNetworkDB:
         errors = []
 
         def run_group(index, members):
-            shard = self._shards[index]
+            shard = self._shard_at(index)
             sub_ops = [sub for _, sub in members]
             mutating = any(
                 op not in ("read", "count") for op, _, _ in sub_ops
             )
             try:
                 if mutating:
-                    outcomes = getattr(shard.primary, primitive)(sub_ops)
-                    shard.note_write()
+                    # Same discipline as _shard_mutate: the client is
+                    # captured once (fence-stamps the connection the batch
+                    # rode), failures feed the promotion detector — the
+                    # producer's q-round rides THIS path, so a dead
+                    # primary must trip the election from here too.
+                    primary = shard.primary
+                    try:
+                        outcomes = getattr(primary, primitive)(sub_ops)
+                    except Exception as exc:
+                        self._note_primary_error(shard, exc)
+                        raise
+                    self._fence_stale_write(shard, primary, primitive)
+                    shard.clear_primary_failure()
                 else:
                     outcomes = self._shard_read(shard, primitive, sub_ops)
             except Exception as exc:

@@ -82,6 +82,8 @@ class _ShardDeployment:
         self.proxy = FaultProxy(self.primary_host, self.primary_port)
         self.proxy.serve_background()
         self.restarts = 0
+        self.killed = False
+        self._make_db = None
 
     def _start_primary(self, port):
         return DBServer(
@@ -122,6 +124,13 @@ class _ShardDeployment:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.05)
+        if self._make_db is not None:
+            # Faults survive the restart: the schedule keeps counting ops
+            # on the reborn primary (a restart silently un-wrapping the
+            # store made short runs' "every fault class fired" assertions
+            # hash-placement-flaky — a lightly loaded shard could restart
+            # before its first plan index).
+            self.primary.db = self._make_db(self.primary.db)
         self.primary.serve_background()
         self.restarts += 1
 
@@ -134,15 +143,58 @@ class _ShardDeployment:
         server.server_close()
         self.replica_servers[replica_index] = None
 
+    def wait_replicated(self, timeout=10.0):
+        """Block until at least one live replica has acknowledged the
+        primary's full position.  Replication is ASYNCHRONOUS — a primary
+        killed with an unreplicated tail loses that tail by design; the
+        zero-lost promotion scenario is 'the most-caught-up replica holds
+        everything', which this wait establishes deterministically."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.primary.replication_status()
+            want = status["seq"]
+            acked = [
+                link["acked_seq"]
+                for link in status["links"]
+                if link["acked_seq"] is not None
+            ]
+            if not want or (acked and max(acked) >= want):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def kill_primary(self, wait_catchup=True):
+        """PERMANENT primary loss — no restart, no graceful flush: the
+        automatic-promotion scenario.  Routers that keep writing must
+        elect the most-caught-up replica themselves (no human in the
+        loop)."""
+        import socketserver as _socketserver
+
+        if wait_catchup:
+            self.wait_replicated()
+        primary = self.primary
+        primary._stop_flusher.set()
+        for link in primary._repl_links:
+            link.stop(flush=False)
+        if getattr(primary, "_serving", False):
+            _socketserver.ThreadingTCPServer.shutdown(primary)
+        primary.close_connections()
+        primary.server_close()
+        self.killed = True
+
     def install_faults(self, make_db):
         """Wrap the primary's store (e.g. in a seeded
         :class:`~orion_tpu.storage.faults.FaultyDB`) — BEFORE any client
-        connects, so every handler sees the wrapped store."""
+        connects, so every handler sees the wrapped store.  The wrapper is
+        re-applied across :meth:`restart_primary` (a fresh wrapper around
+        the reborn store, driven by the SAME schedule — the op count and
+        fault budget carry across the restart)."""
+        self._make_db = make_db
         self.primary.db = make_db(self.primary.db)
 
     def stop(self):
         self.proxy.stop()
-        for server in [self.primary] + self.replica_servers:
+        for server in ([] if self.killed else [self.primary]) + self.replica_servers:
             if server is None:
                 continue
             server.shutdown()
@@ -153,6 +205,9 @@ class SoakTopology:
     """An in-process sharded, replicated deployment under fault control."""
 
     def __init__(self, n_shards=3, replicas=2, persist_dir=None, secret=None):
+        self.replicas = replicas
+        self.persist_dir = persist_dir
+        self.secret = secret
         self.shards = [
             _ShardDeployment(i, replicas, persist_dir, secret=secret)
             for i in range(n_shards)
@@ -161,9 +216,25 @@ class SoakTopology:
     def specs(self):
         return [shard.serve_spec() for shard in self.shards]
 
+    def add_shard(self, replicas=None):
+        """Grow the topology by one shard (the rebalance-mid-soak leg):
+        the new deployment starts empty; `db rebalance` moves ~1/N of the
+        experiments onto it once routers adopt the new spec list."""
+        shard = _ShardDeployment(
+            len(self.shards),
+            self.replicas if replicas is None else replicas,
+            self.persist_dir,
+            secret=self.secret,
+        )
+        self.shards.append(shard)
+        return shard
+
     def make_router(self, **kwargs):
         kwargs.setdefault("timeout", 5.0)
         kwargs.setdefault("reconnect_jitter", 0.05)
+        # Soak runs compress time: a dead primary should promote within a
+        # couple of op retries, not the production-grade 1.5s window.
+        kwargs.setdefault("promote_after", 0.4)
         return ShardedNetworkDB(self.specs(), **kwargs)
 
     def drop_all(self):
@@ -188,6 +259,58 @@ class SoakTopology:
             shard.stop()
 
 
+def busiest_shard(topology, router, n_experiments):
+    """Shard index the ring gave the most soak experiments — the
+    kill-primary chaos legs target it, so promotion must heal a shard
+    under live write load, never an idle corner."""
+    from orion_tpu.core.experiment import experiment_id
+
+    counts = {shard.index: 0 for shard in topology.shards}
+    for e in range(n_experiments):
+        owner = router.shard_for(experiment_id(f"soak-{e}", 1, "soak"))
+        counts[owner] = counts.get(owner, 0) + 1
+    return max(counts, key=lambda index: counts[index])
+
+
+def grow_and_rebalance(topology, storages, fence_grace=0.3,
+                       placement_ttl=0.2, max_grows=5):
+    """The rebalance-mid-soak hook body, shared by ``bench.py --soak`` and
+    the tier-1 pin (the gate and the pin must exercise ONE scenario):
+    grow the topology until the ring diff actually moves something —
+    shard identities carry randomly assigned ports, so a tiny experiment
+    set can (rarely) hash entirely onto the survivors and each extra
+    shard re-rolls the draw — retarget every live router in place, then
+    run the migrator to completion.  Returns
+    ``{"planned": <plan summary>, "n_shards": N, "executed": True}``."""
+    from orion_tpu.storage.rebalance import Rebalancer
+
+    outcome = {}
+    admin = None
+    plan = None
+    try:
+        for _ in range(max_grows):
+            topology.add_shard()
+            specs = topology.specs()
+            for storage in storages:
+                storage.db.set_topology(specs)
+            if admin is not None:
+                admin.close()
+            admin = topology.make_router(
+                replica_reads=False, placement_ttl=placement_ttl
+            )
+            plan = Rebalancer(admin, fence_grace=fence_grace).plan()
+            if plan.moves:
+                break
+        outcome["planned"] = plan.summary()
+        outcome["n_shards"] = len(topology.shards)
+        Rebalancer(admin, fence_grace=fence_grace).run(plan)
+        outcome["executed"] = True
+    finally:
+        if admin is not None:
+            admin.close()
+    return outcome
+
+
 class SoakResult:
     """Outcome of one :func:`drive_soak` run."""
 
@@ -203,6 +326,8 @@ class SoakResult:
         self.replica_stale_reads = 0
         self.reconnects = 0
         self.restarts = 0
+        self.promotions = 0
+        self.primary_kills = 0
 
     @property
     def audits_clean(self):
@@ -227,6 +352,8 @@ class SoakResult:
             "replica_stale_reads": self.replica_stale_reads,
             "reconnects": self.reconnects,
             "shard_restarts": self.restarts,
+            "promotions": self.promotions,
+            "primary_kills": self.primary_kills,
             "duration_s": round(self.duration_s, 3),
         }
 
@@ -329,10 +456,22 @@ def drive_soak(
     errors_lock = threading.Lock()
     barrier = None
     if mid_hook is not None:
+        # A hook declaring a parameter receives the live router-backed
+        # storages — the rebalance-mid-soak leg retargets their topology
+        # in place while every worker holds at the barrier.
+        import inspect
+
+        try:
+            hook_params = list(inspect.signature(mid_hook).parameters)
+        except (TypeError, ValueError):  # pragma: no cover - builtins
+            hook_params = []
 
         def hook_action():
             try:
-                mid_hook()
+                if hook_params:
+                    mid_hook(storages)
+                else:
+                    mid_hook()
             except Exception:  # pragma: no cover - chaos must not kill the run
                 log.exception("mid-run chaos hook failed")
 
@@ -414,9 +553,26 @@ def drive_soak(
     # fleet-wide freshness — a replica caught up to THIS router's writes
     # can still trail another router's, and verification wants the
     # authoritative answer, not an eventually-consistent one.
-    sweep_storage = DocumentStorage(
-        topology.make_router(replica_reads=False), retry=retry
-    )
+    sweep_router = topology.make_router(replica_reads=False)
+    # A permanently killed primary is likely already healed by the worker
+    # routers' elections, but THIS fresh router still dials the dead
+    # address: poke each killed shard until its failure detector adopts
+    # the promoted replica — BEFORE DocumentStorage's index setup fans
+    # out to every shard.
+    for position, deployment in enumerate(topology.shards):
+        if not deployment.killed:
+            continue
+        poke_deadline = time.monotonic() + 15.0
+        while time.monotonic() < poke_deadline:
+            check_deadline()
+            try:
+                sweep_router._shard_read(
+                    sweep_router._shards[position], "count", "experiments"
+                )
+                break
+            except Exception:
+                time.sleep(0.1)
+    sweep_storage = DocumentStorage(sweep_router, retry=retry)
     storages.append(sweep_storage)
     for exp_id in exp_ids:
         while True:
@@ -464,6 +620,8 @@ def drive_soak(
     result.replica_stale_reads = sum(s.db.replica_stale_reads for s in storages)
     result.reconnects = sum(s.db.reconnects for s in storages)
     result.restarts = sum(s.restarts for s in topology.shards)
+    result.promotions = sum(s.db.promotions for s in storages)
+    result.primary_kills = sum(1 for s in topology.shards if s.killed)
     result.duration_s = time.monotonic() - t0
     for storage in storages:
         storage.db.close()

@@ -340,14 +340,38 @@ class Telemetry:
         """Expose ``obj.attr`` (a monotonic int the owner already maintains,
         e.g. ``SQLiteDB.txn_count``) as counter ``name``.  Sampled lazily at
         snapshot time; held by weakref so registration never extends the
-        owner's lifetime.  Multiple registrations under one name sum."""
+        owner's lifetime.  Multiple registrations under one name sum —
+        but re-registering the SAME object+attr is a no-op, so callers
+        that re-run their registration loop (the sharded router after a
+        live topology change) don't double-count."""
         try:
             ref = weakref.ref(obj)
         except TypeError:  # pragma: no cover - exotic objects without weakref
             return
         with self._lock:
             TSAN.write("Telemetry._metrics", self)
-            self._external.setdefault(name, []).append((ref, attr))
+            entries = self._external.setdefault(name, [])
+            for existing_ref, existing_attr in entries:
+                if existing_ref() is obj and existing_attr == attr:
+                    return
+            entries.append((ref, attr))
+
+    def unregister_external_counter(self, name, obj):
+        """Drop ``obj``'s registration under ``name`` (other objects'
+        registrations under the same name stay).  The sharded router uses
+        this when a live topology change REINDEXES a surviving shard —
+        its counters move to the new ``s{i}`` name and must stop
+        exporting under the old one."""
+        with self._lock:
+            TSAN.write("Telemetry._metrics", self)
+            entries = self._external.get(name)
+            if not entries:
+                return
+            kept = [e for e in entries if e[0]() is not obj]
+            if kept:
+                self._external[name] = kept
+            else:
+                self._external.pop(name, None)
 
     def _external_counts(self):
         out = {}

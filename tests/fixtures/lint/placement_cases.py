@@ -1,0 +1,76 @@
+"""STO005 fixtures: placement/epoch mutations must ride a
+RetryPolicy.run(..., mode=...) with an explicit applied-or-not mode.
+
+The placement override collection is the routing ground truth of live
+rebalancing; the `promote` wire op reshapes a shard's epoch.  A bare
+mutation that dies mid-wire leaves the state machine half-flipped with
+no declared convergence contract.
+"""
+
+PLACEMENT_COLLECTION = "_placement"
+
+MODE_ALWAYS = "always"
+
+
+class GoodMigrator:
+    """Placement ops routed through the policy with an explicit mode."""
+
+    def __init__(self, policy):
+        self.policy = policy
+
+    def flip(self, dst, doc_id, fields):
+        def upsert():
+            # Covered: the ENCLOSING function runs it under the policy.
+            dst.write(PLACEMENT_COLLECTION, dict(fields), query={"_id": doc_id})
+
+        self.policy.run(upsert, op="flip", mode=MODE_ALWAYS)
+
+    def drop(self, dst, doc_id):
+        self.policy.run(
+            lambda: dst.remove(PLACEMENT_COLLECTION, {"_id": doc_id}),
+            op="drop",
+            mode=MODE_ALWAYS,
+        )
+
+    def elect(self, shard, winner, peers):
+        return shard.policy.run(
+            lambda: winner._call("promote", {"epoch": 2, "replicate_to": peers}),
+            op="promote",
+            mode=MODE_ALWAYS,
+        )
+
+    def lookup(self, dst, doc_id):
+        # Reads are not mutations: no coverage demanded.
+        return dst.read(PLACEMENT_COLLECTION, {"_id": doc_id})
+
+
+class BadMigrator:
+    """Bare placement/epoch mutations: no policy, no declared mode."""
+
+    def flip(self, dst, doc_id, fields):
+        dst.write("_placement", dict(fields), query={"_id": doc_id})  # expect: STO005
+
+    def flip_by_name(self, dst, doc_id, fields):
+        dst.write(PLACEMENT_COLLECTION, dict(fields), query={"_id": doc_id})  # expect: STO005
+
+    def drop(self, dst, doc_id):
+        dst.remove("_placement", {"_id": doc_id})  # expect: STO005
+
+    def cas(self, dst, doc_id, fields):
+        return dst.read_and_write("_placement", {"_id": doc_id}, fields)  # expect: STO005
+
+    def elect(self, winner, peers):
+        return winner._call("promote", {"epoch": 2, "replicate_to": peers})  # expect: STO005
+
+
+class ModelessMigrator:
+    """Riding the policy is NOT enough: the mode must be explicit."""
+
+    def __init__(self, policy):
+        self.policy = policy
+
+    def flip(self, dst, doc_id, fields):
+        self.policy.run(
+            lambda: dst.write("_placement", dict(fields), query={"_id": doc_id}),  # expect: STO005
+            op="flip",
+        )

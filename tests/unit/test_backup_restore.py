@@ -1,0 +1,176 @@
+"""Cross-shard snapshot backup/restore (storage/backup.py, `db backup` /
+`db restore`).
+
+The disaster-recovery contract: a 3-shard topology round-trips through a
+backup directory onto a FRESH topology — even one with a different shard
+count — with identical trial counts and clean audits; a crashed backup
+(no manifest) refuses to restore; a non-empty destination refuses unless
+forced; a crashed restore re-runs convergently.
+"""
+
+import os
+
+import pytest
+
+from orion_tpu.core.experiment import experiment_id
+from orion_tpu.storage.audit import audit_storage
+from orion_tpu.storage.backup import (
+    MANIFEST,
+    backup_topology,
+    load_manifest,
+    restore_topology,
+)
+from orion_tpu.storage.base import DocumentStorage
+from orion_tpu.storage.netdb import DBServer, NetworkDB
+from orion_tpu.storage.shard import ShardedNetworkDB
+from orion_tpu.utils.exceptions import DatabaseError
+
+N_EXPERIMENTS = 9
+TRIALS_PER_EXP = 5
+
+
+def _spec(servers):
+    return [{"host": s.address[0], "port": s.address[1]} for s in servers]
+
+
+def _populate(router):
+    for e in range(N_EXPERIMENTS):
+        name = f"exp-{e}"
+        eid = experiment_id(name, 1, "u")
+        router.write(
+            "experiments",
+            {"_id": eid, "name": name, "version": 1, "metadata": {"user": "u"}},
+        )
+        router.write("trials", [
+            {
+                "_id": f"{eid}-t{i}", "experiment": eid, "status": "completed",
+                "objective": float(i), "params": {"/x": float(i)},
+                "results": [
+                    {"name": "obj", "type": "objective", "value": float(i)}
+                ],
+                "submit_time": 1.0, "start_time": 1.0, "end_time": 2.0,
+                "heartbeat": 2.0,
+            }
+            for i in range(TRIALS_PER_EXP)
+        ])
+
+
+@pytest.fixture
+def source():
+    servers = [DBServer(port=0) for _ in range(3)]
+    for server in servers:
+        server.serve_background()
+    router = ShardedNetworkDB(_spec(servers), reconnect_jitter=0, timeout=3.0)
+    _populate(router)
+    yield router
+    router.close()
+    for server in servers:
+        server.shutdown()
+        server.server_close()
+
+
+def _fresh_topology(n):
+    servers = [DBServer(port=0) for _ in range(n)]
+    for server in servers:
+        server.serve_background()
+    router = ShardedNetworkDB(_spec(servers), reconnect_jitter=0, timeout=3.0)
+    return router, servers
+
+
+def test_three_shard_roundtrip_to_fresh_topology(source, tmp_path):
+    out = str(tmp_path / "backup")
+    manifest = backup_topology(source, out)
+    assert len(manifest["shards"]) == 3
+    assert os.path.exists(os.path.join(out, MANIFEST))
+    total_docs = sum(entry["docs"] for entry in manifest["shards"])
+    assert total_docs >= N_EXPERIMENTS * (TRIALS_PER_EXP + 1)
+    # Restore onto a DIFFERENT shard count: docs land by the NEW ring.
+    dest, servers = _fresh_topology(2)
+    try:
+        summary = restore_topology(dest, out)
+        assert summary["collections"]["experiments"] == N_EXPERIMENTS
+        assert summary["collections"]["trials"] == N_EXPERIMENTS * TRIALS_PER_EXP
+        assert dest.count("trials", {}) == source.count("trials", {})
+        assert dest.count("experiments", {}) == N_EXPERIMENTS
+        # Every experiment audits clean on its restored shard, and counts
+        # per experiment are identical to the source.
+        for index, conn in dest.shard_connections():
+            reports = audit_storage(DocumentStorage(conn), lost_timeout=3600.0)
+            assert all(r.ok for r in reports), [r.violations for r in reports]
+        for e in range(N_EXPERIMENTS):
+            eid = experiment_id(f"exp-{e}", 1, "u")
+            assert dest.count("trials", {"experiment": eid}) == TRIALS_PER_EXP
+        # A restored destination round-trips again (counts conserved).
+        assert (
+            backup_topology(dest, str(tmp_path / "b2"))["shards"][0]["docs"]
+            >= 0
+        )
+    finally:
+        dest.close()
+        for server in servers:
+            server.shutdown()
+            server.server_close()
+
+
+def test_backup_includes_seq_and_epoch_stamps(tmp_path):
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    primary = DBServer(port=0, replicate_to=[replica.address])
+    primary.serve_background()
+    client = NetworkDB(
+        host=primary.address[0], port=primary.address[1], reconnect_jitter=0
+    )
+    try:
+        client.write("trials", {"_id": "t1", "experiment": "e"})
+        manifest = backup_topology(client, str(tmp_path / "b"))
+        entry = manifest["shards"][0]
+        assert entry["seq"] == 1 and entry["epoch"] == 1
+        assert entry["collections"].get("trials") == 1
+    finally:
+        client.close()
+        for server in (primary, replica):
+            server.shutdown()
+            server.server_close()
+
+
+def test_restore_refuses_without_manifest_and_non_empty_target(source, tmp_path):
+    incomplete = str(tmp_path / "no-manifest")
+    os.makedirs(incomplete)
+    with pytest.raises(DatabaseError, match="manifest"):
+        load_manifest(incomplete)
+    with pytest.raises(DatabaseError, match="manifest"):
+        restore_topology(source, incomplete)
+    out = str(tmp_path / "backup")
+    backup_topology(source, out)
+    # The SOURCE is non-empty: restoring over it must refuse...
+    with pytest.raises(DatabaseError, match="FRESH"):
+        restore_topology(source, out)
+    # ...unless forced — and the forced merge is convergent (dedup by id).
+    summary = restore_topology(source, out, require_empty=False)
+    assert summary["collections"]["trials"] == N_EXPERIMENTS * TRIALS_PER_EXP
+    assert source.count("trials", {}) == N_EXPERIMENTS * TRIALS_PER_EXP
+
+
+def test_crashed_restore_reruns_convergently(source, tmp_path):
+    out = str(tmp_path / "backup")
+    backup_topology(source, out)
+    dest, servers = _fresh_topology(2)
+    try:
+        # Simulate a crashed earlier restore: half the docs already landed.
+        for entry in load_manifest(out)["shards"][:1]:
+            import json
+
+            with open(os.path.join(out, entry["file"])) as handle:
+                payload = json.load(handle)
+            for collection, docs in payload["collections"].items():
+                if collection.startswith("_") or not docs:
+                    continue
+                dest.write(collection, docs)
+        restore_topology(dest, out, require_empty=False)
+        assert dest.count("trials", {}) == N_EXPERIMENTS * TRIALS_PER_EXP
+        assert dest.count("experiments", {}) == N_EXPERIMENTS
+    finally:
+        dest.close()
+        for server in servers:
+            server.shutdown()
+            server.server_close()

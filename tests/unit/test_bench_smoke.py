@@ -152,6 +152,18 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     assert soak["failovers"] >= 1
     assert soak["reconnects"] >= 1
     assert sum(soak["completed_per_shard"].values()) == soak["completed"]
+    # The ISSUE-14 promotion leg: a primary was killed for good and the
+    # router fleet healed it by electing a replica — no manual restart.
+    assert soak["primary_kills"] >= 1
+    assert soak["promotions"] >= 1
+    # The rebalance-mid-soak leg: the topology grew by >= 1 shard and the
+    # migrator moved ~1/N of the experiments with zero lost observations.
+    rebalance = payload["rebalance_soak"]
+    assert rebalance["lost_observations"] == 0
+    assert rebalance["audits_clean"] is True
+    assert rebalance["rebalance"]["executed"] is True
+    assert rebalance["rebalance"]["planned"]["moves"] >= 1
+    assert sum(rebalance["completed_per_shard"].values()) == rebalance["completed"]
     assert serve["per_tenant"] and all(
         row["p99_ms"] > 0 for row in serve["per_tenant"].values()
     )

@@ -1,0 +1,601 @@
+"""Automatic replica promotion + epoch fencing (storage/netdb.py,
+storage/shard.py).
+
+The self-healing contract: a dead primary is replaced by the
+most-caught-up replica through a deterministic router-side election (the
+``promote`` wire op), a reborn stale primary DEMOTES itself on first
+contact with the newer epoch and snapshot-resyncs instead of
+split-braining, and the epoch fence holds on both halves of the wire —
+the demoted server refuses client mutations outright, and a router that
+has seen a newer epoch refuses (and retries) a reply stamped with an
+older one.
+"""
+
+import socketserver
+import threading
+import time
+
+import pytest
+
+from orion_tpu.storage.netdb import DBServer, NetworkDB
+from orion_tpu.storage.shard import ShardedNetworkDB
+from orion_tpu.utils.exceptions import DatabaseError
+
+
+def _client(server, **kwargs):
+    kwargs.setdefault("reconnect_jitter", 0)
+    host, port = server.address
+    return NetworkDB(host=host, port=port, **kwargs)
+
+
+def _wait_for(predicate, timeout=8.0, message="condition never held"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    raise AssertionError(message)
+
+
+def _stop(*servers):
+    for server in servers:
+        try:
+            server.shutdown()
+            server.server_close()
+        except Exception:
+            pass
+
+
+def _hard_kill(server):
+    """Kill without the graceful final replica flush — a crashed box."""
+    server._stop_flusher.set()
+    for link in server._repl_links:
+        link.stop(flush=False)
+    if getattr(server, "_serving", False):
+        socketserver.ThreadingTCPServer.shutdown(server)
+    server.close_connections()
+    server.server_close()
+
+
+def _shard_spec(primary, replicas):
+    return [{
+        "host": primary.address[0],
+        "port": primary.address[1],
+        "replicas": [r.address for r in replicas],
+    }]
+
+
+# --- promote wire op ---------------------------------------------------------
+def test_promote_flips_replica_to_primary_and_is_idempotent():
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    client = _client(replica)
+    try:
+        result = client._call("promote", {"epoch": 3, "replicate_to": []})
+        assert result["promoted"] is True
+        assert result["primary"] is True and result["epoch"] == 3
+        assert replica.seq_info()["replica"] is False
+        assert replica.seq_info()["epoch"] == 3
+        # Same-or-lower epoch resend: reports standing state, never re-flips.
+        again = client._call("promote", {"epoch": 3, "replicate_to": []})
+        assert again["promoted"] is False and again["primary"] is True
+        lower = client._call("promote", {"epoch": 2, "replicate_to": []})
+        assert lower["promoted"] is False and lower["epoch"] == 3
+        # The promoted primary accepts mutations and stamps its epoch.
+        client.write("trials", {"_id": "t1", "experiment": "e"})
+        assert client.stamp_snapshot() == (1, 3)
+    finally:
+        client.close()
+        _stop(replica)
+
+
+def test_promotion_epoch_survives_restart(tmp_path):
+    persist = str(tmp_path / "r.pkl")
+    replica = DBServer(port=0, replica=True, persist=persist,
+                       persist_interval=0.05)
+    replica.serve_background()
+    client = _client(replica)
+    try:
+        client._call("promote", {"epoch": 5, "replicate_to": []})
+        client.write("trials", {"_id": "t1", "experiment": "e"})
+        _wait_for(lambda: replica.seq_info()["seq"] == 1)
+    finally:
+        client.close()
+        _stop(replica)
+    reborn = DBServer(port=0, persist=persist)
+    try:
+        info = reborn.seq_info()
+        assert info["epoch"] == 5 and info["seq"] == 1
+    finally:
+        _stop(reborn)
+
+
+# --- epoch fencing (server half) --------------------------------------------
+def test_replica_refuses_client_mutations_with_not_primary_marker():
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    client = _client(replica)
+    try:
+        with pytest.raises(DatabaseError) as err:
+            client.write("trials", {"_id": "t1", "experiment": "e"})
+        assert getattr(err.value, "not_primary", False) is True
+        assert getattr(err.value, "maybe_applied", False) is False
+        # Batches with mutating sub-ops refuse identically (pre-apply).
+        outcome = None
+        with pytest.raises(DatabaseError):
+            outcome = client.apply_batch(
+                [("write", ["trials", {"_id": "t2", "experiment": "e"}], {})]
+            )
+        assert outcome is None
+        assert client.count("trials") == 0  # nothing was applied
+        # Reads stay open: replicas exist to serve them.
+        assert client.read("trials", {}) == []
+    finally:
+        client.close()
+        _stop(replica)
+
+
+def test_stale_primary_push_is_fenced_and_demotes_the_pusher():
+    """The split-brain window repro: an old primary pushing a LOWER epoch
+    must be refused (never applied), and the refusal must demote it."""
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    client = _client(replica)
+    try:
+        client._call("promote", {"epoch": 4, "replicate_to": []})
+        # A push from epoch 2 (a stale primary's stream) is fenced.
+        reply = client._call(
+            "replicate",
+            {"entries": [[1, "write", ["trials", {"_id": "zombie"}], {}]],
+             "epoch": 2},
+        )
+        assert reply.get("fenced") is True and reply["epoch"] == 4
+        assert client.count("trials") == 0, "fenced entries must never apply"
+    finally:
+        client.close()
+        _stop(replica)
+
+
+def test_reborn_stale_primary_demotes_and_snapshot_resyncs(tmp_path):
+    """The full split-brain scenario: primary dies hard, a replica is
+    promoted and takes NEW writes, the old primary comes back from its
+    persisted image still thinking it is epoch-1 primary — one contact
+    with the newer epoch demotes it, its diverged state is erased by a
+    snapshot resync, and client mutations against it refuse from the
+    moment of demotion (no write accepted from a lower epoch)."""
+    persist = str(tmp_path / "p.pkl")
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    primary = DBServer(port=0, persist=persist, persist_interval=0.05,
+                       replicate_to=[replica.address])
+    primary.serve_background()
+    port = primary.address[1]
+    writer = _client(primary)
+    writer.write("trials", [{"_id": f"t{i}", "experiment": "e"} for i in range(3)])
+    _wait_for(lambda: replica.seq_info()["seq"] == primary.seq_info()["seq"])
+    writer.close()
+    _hard_kill(primary)
+    # Promote the replica; it takes a post-election write.
+    promote_client = _client(replica)
+    result = promote_client._call(
+        "promote",
+        {"epoch": 2, "replicate_to": [("127.0.0.1", port)]},
+    )
+    assert result["promoted"] is True
+    promote_client.write("trials", {"_id": "t-after", "experiment": "e"})
+    # Reborn old primary: persisted epoch 1, still configured as primary.
+    reborn = DBServer(host="127.0.0.1", port=port, persist=persist,
+                      persist_interval=0.05, replicate_to=[replica.address])
+    assert reborn.seq_info()["epoch"] == 1
+    reborn.serve_background()
+    # Its own pusher probes the promoted node (epoch 2) -> demote.
+    _wait_for(lambda: reborn.seq_info()["replica"],
+              message="reborn stale primary never demoted")
+    stale_client = _client(reborn)
+    with pytest.raises(DatabaseError) as err:
+        stale_client.write("trials", {"_id": "fork", "experiment": "e"})
+    assert getattr(err.value, "not_primary", False) is True
+    # The new primary's pusher snapshot-resyncs the demoted box.
+    _wait_for(
+        lambda: (
+            not reborn.seq_info()["resyncing"]
+            and reborn.seq_info()["epoch"] == 2
+            and reborn.seq_info()["seq"] == replica.seq_info()["seq"]
+        ),
+        message="demoted primary never snapshot-resynced",
+    )
+    docs = stale_client.read("trials", {"experiment": "e"})
+    assert sorted(d["_id"] for d in docs) == ["t-after", "t0", "t1", "t2"]
+    stale_client.close()
+    promote_client.close()
+    _stop(reborn, replica)
+
+
+# --- router-side election ----------------------------------------------------
+def test_router_elects_most_caught_up_replica_and_heals_writes():
+    behind = DBServer(port=0, replica=True)
+    behind.serve_background()
+    ahead = DBServer(port=0, replica=True)
+    ahead.serve_background()
+    primary = DBServer(port=0, replicate_to=[behind.address, ahead.address])
+    primary.serve_background()
+    router = ShardedNetworkDB(
+        _shard_spec(primary, [behind, ahead]),
+        reconnect_jitter=0, timeout=2.0, promote_after=0.2,
+    )
+    try:
+        router.write("trials", [{"_id": f"t{i}", "experiment": "e"} for i in range(4)])
+        _wait_for(lambda: ahead.seq_info()["seq"] == primary.seq_info()["seq"]
+                  and behind.seq_info()["seq"] == primary.seq_info()["seq"])
+        # Leave only `ahead` electable (killing `behind` is the simplest
+        # honest way to pin WHICH node must win), then kill the primary.
+        _stop(behind)
+        _hard_kill(primary)
+        deadline = time.monotonic() + 20.0
+        healed = False
+        while time.monotonic() < deadline:
+            try:
+                router.write("trials", {"_id": "t-heal", "experiment": "e"})
+                healed = True
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert healed, "router never promoted a replica after primary death"
+        assert router.promotions >= 1
+        assert ahead.seq_info()["replica"] is False  # the survivor won
+        docs = router.read("trials", {"experiment": "e"})
+        assert len(docs) == 5
+    finally:
+        router.close()
+        _stop(ahead, primary, behind)
+
+
+def test_concurrent_routers_converge_on_the_same_winner():
+    """Two routers detecting the same dead primary must not elect two
+    different primaries: the promote op is idempotent at one epoch and
+    the candidate order is deterministic, so both end up on ONE node."""
+    replicas = [DBServer(port=0, replica=True) for _ in range(2)]
+    for r in replicas:
+        r.serve_background()
+    primary = DBServer(port=0, replicate_to=[r.address for r in replicas])
+    primary.serve_background()
+    spec = _shard_spec(primary, replicas)
+    routers = [
+        ShardedNetworkDB(spec, reconnect_jitter=0, timeout=2.0, promote_after=0.1)
+        for _ in range(2)
+    ]
+    try:
+        routers[0].write("trials", {"_id": "seed", "experiment": "e"})
+        _wait_for(lambda: all(
+            r.seq_info()["seq"] == 1 for r in replicas
+        ))
+        _hard_kill(primary)
+
+        def heal(router, results, i):
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                try:
+                    router.write(
+                        "trials", {"_id": f"heal-{i}", "experiment": "e"}
+                    )
+                    results[i] = True
+                    return
+                except Exception:
+                    time.sleep(0.05)
+
+        results = [False, False]
+        threads = [
+            threading.Thread(target=heal, args=(router, results, i))
+            for i, router in enumerate(routers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(results), "a router never healed past the dead primary"
+        primaries = [r for r in replicas if not r.seq_info()["replica"]]
+        assert len(primaries) == 1, "split brain: two replicas claim primary"
+        # Both routers' writes landed on the one winner.
+        winner_client = _client(primaries[0])
+        ids = {d["_id"] for d in winner_client.read("trials", {"experiment": "e"})}
+        winner_client.close()
+        assert {"seed", "heal-0", "heal-1"} <= ids
+    finally:
+        for router in routers:
+            router.close()
+        _stop(primary, *replicas)
+
+
+def test_router_adopts_promotion_it_did_not_run():
+    """A router that missed the election (its first failure is a
+    not-primary refusal from the demoted old primary, or a dead socket)
+    adopts the standing winner instead of bumping the epoch again."""
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    primary = DBServer(port=0, replicate_to=[replica.address])
+    primary.serve_background()
+    spec = _shard_spec(primary, [replica])
+    early = ShardedNetworkDB(spec, reconnect_jitter=0, timeout=2.0,
+                             promote_after=0.1)
+    late = ShardedNetworkDB(spec, reconnect_jitter=0, timeout=2.0,
+                            promote_after=0.1)
+    try:
+        early.write("trials", {"_id": "seed", "experiment": "e"})
+        _wait_for(lambda: replica.seq_info()["seq"] == 1)
+        _hard_kill(primary)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                early.write("trials", {"_id": "by-early", "experiment": "e"})
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert early.promotions >= 1
+        epoch_after_election = replica.seq_info()["epoch"]
+        # The late router now writes: dead socket -> probe -> ADOPT.
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                late.write("trials", {"_id": "by-late", "experiment": "e"})
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert replica.seq_info()["epoch"] == epoch_after_election, (
+            "adoption must not mint a new epoch"
+        )
+        docs = late.read("trials", {"experiment": "e"})
+        assert {d["_id"] for d in docs} == {"seed", "by-early", "by-late"}
+    finally:
+        early.close()
+        late.close()
+        _stop(primary, replica)
+
+
+def test_promoted_primary_restart_reelects_in_place(tmp_path):
+    """A promoted replica that RESTARTS comes back in its configured
+    replica role (epoch persisted, role not): every node now answers as a
+    replica, so simple adoption finds nothing — the routers' not-primary
+    refusals must feed the confirmation window and a real election must
+    re-promote the caught-up node IN PLACE at a fresh epoch, or the shard
+    would refuse writes forever with a healthy, electable node sitting in
+    the primary slot."""
+    persist = str(tmp_path / "b.pkl")
+    replica = DBServer(port=0, replica=True, persist=persist,
+                       persist_interval=0.05)
+    replica.serve_background()
+    replica_port = replica.address[1]
+    primary = DBServer(port=0, replicate_to=[replica.address])
+    primary.serve_background()
+    router = ShardedNetworkDB(
+        _shard_spec(primary, [replica]),
+        reconnect_jitter=0, timeout=2.0, promote_after=0.2,
+    )
+    try:
+        router.write("trials", {"_id": "seed", "experiment": "e"})
+        _wait_for(lambda: replica.seq_info()["seq"] == 1)
+        _hard_kill(primary)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                router.write("trials", {"_id": "post-elect", "experiment": "e"})
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert router.promotions >= 1
+        assert replica.seq_info()["epoch"] == 2
+        # Give the persist flusher a beat, then RESTART the promoted node
+        # with its original replica config on the same port.
+        _wait_for(lambda: replica.seq_info()["seq"] == 2)
+        time.sleep(0.15)
+        replica.shutdown()
+        replica.server_close()
+        reborn = DBServer(host="127.0.0.1", port=replica_port, replica=True,
+                          persist=persist, persist_interval=0.05)
+        info = reborn.seq_info()
+        assert info["replica"] is True and info["epoch"] == 2
+        reborn.serve_background()
+        healed = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                router.write("trials", {"_id": "post-restart", "experiment": "e"})
+                healed = True
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert healed, "shard never healed after the promoted node restarted"
+        info = reborn.seq_info()
+        assert info["replica"] is False, "re-election must flip it back"
+        assert info["epoch"] >= 3, "re-promotion mints a fresh epoch"
+        docs = router.read("trials", {"experiment": "e"})
+        assert {d["_id"] for d in docs} >= {"seed", "post-elect", "post-restart"}
+        _stop(reborn)
+    finally:
+        router.close()
+        _stop(primary, replica)
+
+
+def test_stale_fork_claimant_is_never_adopted_below_the_epoch_floor(tmp_path):
+    """The double-failure case: after a promotion to epoch 2, the epoch-2
+    node ALSO dies and the original epoch-1 primary is reborn still
+    claiming primary (its only newer-epoch peer is dead, so nothing ever
+    demotes it).  A router that witnessed epoch 2 must NOT adopt or
+    re-elect the stale fork — blessing it would silently discard the
+    epoch-2 timeline; the shard stays (correctly) degraded until an
+    at-floor node returns, and then heals at a fresh epoch."""
+    a_persist = str(tmp_path / "a.pkl")
+    b_persist = str(tmp_path / "b.pkl")
+    b = DBServer(port=0, replica=True, persist=b_persist, persist_interval=0.05)
+    b.serve_background()
+    b_port = b.address[1]
+    a = DBServer(port=0, persist=a_persist, persist_interval=0.05,
+                 replicate_to=[b.address])
+    a.serve_background()
+    a_port = a.address[1]
+    router = ShardedNetworkDB(
+        _shard_spec(a, [b]), reconnect_jitter=0, timeout=2.0,
+        promote_after=0.2,
+    )
+    reborn_a = None
+    reborn_b = None
+    try:
+        router.write("trials", {"_id": "epoch1", "experiment": "e"})
+        _wait_for(lambda: b.seq_info()["seq"] == 1)
+        time.sleep(0.15)  # let A's flusher persist its snapshot
+        _hard_kill(a)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                router.write("trials", {"_id": "epoch2", "experiment": "e"})
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert b.seq_info()["epoch"] == 2  # promoted; router floor is now 2
+        _wait_for(lambda: b.seq_info()["seq"] == 2)
+        time.sleep(0.15)
+        _hard_kill(b)
+        # The stale fork comes back: epoch-1 A, still configured primary,
+        # its only peer (B) dead — nothing will ever demote it.
+        reborn_a = DBServer(host="127.0.0.1", port=a_port, persist=a_persist,
+                            persist_interval=0.05,
+                            replicate_to=[("127.0.0.1", b_port)])
+        assert reborn_a.seq_info()["epoch"] == 1
+        reborn_a.serve_background()
+        # The router must keep REFUSING rather than bless the fork.
+        for _ in range(8):
+            with pytest.raises(Exception):
+                router.write("trials", {"_id": "forked", "experiment": "e"})
+            time.sleep(0.1)
+        fork_reader = _client(reborn_a)
+        assert not fork_reader.read("trials", {"_id": "forked"}), (
+            "a write landed on the stale epoch-1 fork"
+        )
+        fork_reader.close()
+        # The at-floor node returns: the shard heals at a FRESH epoch.
+        reborn_b = DBServer(host="127.0.0.1", port=b_port, replica=True,
+                            persist=b_persist, persist_interval=0.05)
+        assert reborn_b.seq_info()["epoch"] == 2
+        reborn_b.serve_background()
+        healed = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            try:
+                router.write("trials", {"_id": "healed", "experiment": "e"})
+                healed = True
+                break
+            except Exception:
+                time.sleep(0.05)
+        assert healed, "shard never healed once the at-floor node returned"
+        info = reborn_b.seq_info()
+        assert info["replica"] is False and info["epoch"] >= 3
+        docs = router.read("trials", {"experiment": "e"})
+        assert {d["_id"] for d in docs} >= {"epoch1", "epoch2", "healed"}
+    finally:
+        router.close()
+        _stop(a, b)
+        for server in (reborn_a, reborn_b):
+            if server is not None:
+                _stop(server)
+
+
+# --- flight recorder -----------------------------------------------------
+def test_promotion_and_demotion_emit_flight_events():
+    """Post-incident `orion-tpu flight-record` must be able to reconstruct
+    the election: every state transition books a flight event (mirrored
+    into the spans channel as flight.* records by the ordinary flush)."""
+    from orion_tpu.health import FLIGHT
+
+    was = FLIGHT.enabled
+    FLIGHT.enable()
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    client = _client(replica)
+    try:
+        client._call("promote", {"epoch": 7, "replicate_to": []})
+        # A lower-epoch push arriving at the promoted node fences; feed
+        # the refusal back through demote() the way a pusher would.
+        replica.demote(9)
+        kinds = [e["kind"] for e in FLIGHT.events()]
+        assert "promote" in kinds, kinds  # -> flight.promote in spans
+        assert "demote" in kinds, kinds  # -> flight.demote in spans
+        promote = next(e for e in FLIGHT.events() if e["kind"] == "promote")
+        assert promote["args"]["epoch"] == 7
+    finally:
+        client.close()
+        _stop(replica)
+        if not was:
+            FLIGHT.disable()
+        FLIGHT.clear()
+
+
+# --- resync stampede bound (ride-along bugfix) -------------------------------
+def test_resync_snapshots_are_serialized_per_primary(monkeypatch):
+    """A restart storm of R replicas must not stampede the primary with R
+    concurrent O(DB)-size snapshot dumps: the resync gate admits one at a
+    time (jittered), pinned here by observing the build concurrency.
+
+    The storm is DETERMINISTIC: the replicas only come up after the
+    primary's bounded log has already overflowed, so every one of them
+    must converge through a full snapshot — entry replay cannot cover the
+    gap (the discipline of the log-overflow test, stormed by three)."""
+    # Reserve three replica addresses, then take them DOWN so the log
+    # overflows before any push lands.
+    placeholders = [DBServer(port=0, replica=True) for _ in range(3)]
+    addrs = [r.address for r in placeholders]
+    for r in placeholders:
+        _stop(r)
+    primary = DBServer(port=0, replicate_to=addrs)
+    primary.serve_background()
+    state = {"live": 0, "max": 0}
+    state_lock = threading.Lock()
+    original = DBServer._snapshot_payload_locked
+
+    def instrumented(self):
+        with state_lock:
+            state["live"] += 1
+            state["max"] = max(state["max"], state["live"])
+        try:
+            time.sleep(0.05)  # stretch the window a storm would overlap in
+            return original(self)
+        finally:
+            with state_lock:
+                state["live"] -= 1
+
+    monkeypatch.setattr(DBServer, "_snapshot_payload_locked", instrumented)
+    writer = _client(primary)
+    replicas = []
+    try:
+        primary._repl_log = type(primary._repl_log)(
+            primary._repl_log, maxlen=2
+        )
+        for i in range(12):
+            writer.write("trials", {"_id": f"t{i}", "experiment": "e"})
+        # The restart storm: all three replicas come back AT ONCE, each
+        # behind an overflowed log -> each needs a snapshot.
+        replicas = [
+            DBServer(host=host, port=port, replica=True)
+            for host, port in addrs
+        ]
+        for r in replicas:
+            r.serve_background()
+        for link in primary._repl_links:
+            link.notify()
+        _wait_for(
+            lambda: all(
+                r.seq_info()["seq"] == primary.seq_info()["seq"]
+                for r in replicas
+            ),
+            timeout=30.0,
+            message="replicas never converged through the resync storm",
+        )
+        assert state["max"] == 1, (
+            f"{state['max']} concurrent snapshot dumps — the resync gate "
+            "must serialize them"
+        )
+        for host, port in addrs:
+            reader = NetworkDB(host=host, port=port, reconnect_jitter=0)
+            assert len(reader.read("trials", {"experiment": "e"})) == 12
+            reader.close()
+    finally:
+        writer.close()
+        _stop(primary, *replicas)

@@ -1,0 +1,280 @@
+"""Live ring rebalancing (storage/rebalance.py + the router's placement
+override path in storage/shard.py).
+
+The migration state machine must be exactly-once under crashes at its
+two dangerous points — after copy-before-flip and after flip-before-
+delete — with byte-identical documents and clean audits on BOTH shards,
+and the router must honor placement overrides (bounded TTL cache,
+invalidated on an override-routed miss) and hold ops across the fence.
+"""
+
+import time
+
+import pytest
+
+from orion_tpu.core.experiment import experiment_id
+from orion_tpu.storage.base import DocumentStorage
+from orion_tpu.storage.documents import dumps_canonical
+from orion_tpu.storage.netdb import DBServer
+from orion_tpu.storage.rebalance import Rebalancer
+from orion_tpu.storage.shard import (
+    PLACEMENT_COLLECTION,
+    ShardedNetworkDB,
+    placement_doc_id,
+)
+from orion_tpu.storage.audit import audit_storage
+from orion_tpu.utils.exceptions import DatabaseError
+
+
+N_EXPERIMENTS = 12
+TRIALS_PER_EXP = 4
+
+#: Module-level so helpers can map back to the fixture's chosen names.
+_NAMES = []
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+def _pick_names(identities3, identities4):
+    """Choose experiment names whose 3-ring vs 4-ring placement GUARANTEES
+    at least two movers and some stayers: server ports are random, so a
+    fixed name list can (rarely) hash entirely onto the survivors — which
+    would silently skip the crash-resume coverage."""
+    from orion_tpu.storage.shard import HashRing
+
+    ring3, ring4 = HashRing(identities3), HashRing(identities4)
+    movers, stayers = [], []
+    e = 0
+    while (len(movers) < 2 or len(stayers) < N_EXPERIMENTS - 2) and e < 400:
+        name = f"exp-{e}"
+        e += 1
+        eid = experiment_id(name, 1, "u")
+        if ring3.lookup(eid) != ring4.lookup(eid):
+            movers.append(name)
+        else:
+            stayers.append(name)
+    assert len(movers) >= 2, "no movers in 400 draws — ring is broken"
+    chosen = movers[:2] + stayers[: N_EXPERIMENTS - 2]
+    for extra in movers[2:]:
+        if len(chosen) >= N_EXPERIMENTS:
+            break
+        chosen.append(extra)
+    return chosen
+
+
+@pytest.fixture
+def topology():
+    servers = [DBServer(port=0) for _ in range(4)]
+    for server in servers:
+        server.serve_background()
+    spec3 = [
+        {"host": s.address[0], "port": s.address[1]} for s in servers[:3]
+    ]
+    spec4 = spec3 + [
+        {"host": servers[3].address[0], "port": servers[3].address[1]}
+    ]
+    _NAMES[:] = _pick_names(
+        [f"{s['host']}:{s['port']}" for s in spec3],
+        [f"{s['host']}:{s['port']}" for s in spec4],
+    )
+    router = ShardedNetworkDB(
+        spec3, reconnect_jitter=0, timeout=3.0, placement_ttl=0.2
+    )
+    _populate(router)
+    yield router, spec4, servers
+    router.close()
+    for server in servers:
+        server.shutdown()
+        server.server_close()
+
+
+def _populate(router):
+    for name in _NAMES:
+        eid = experiment_id(name, 1, "u")
+        router.write(
+            "experiments",
+            {"_id": eid, "name": name, "version": 1, "metadata": {"user": "u"}},
+        )
+        router.write("trials", [
+            {
+                "_id": f"{eid}-t{i}", "experiment": eid, "status": "completed",
+                "objective": float(i), "params": {"/x": float(i)},
+                "results": [
+                    {"name": "obj", "type": "objective", "value": float(i)}
+                ],
+                "submit_time": 1.0, "start_time": 1.0, "end_time": 2.0,
+                "heartbeat": 2.0,
+            }
+            for i in range(TRIALS_PER_EXP)
+        ])
+        router.write("telemetry", [
+            {"_id": f"{eid}-m", "experiment": eid, "worker": "w0", "kind": "t"}
+        ])
+
+
+def _exp_ids():
+    return [experiment_id(name, 1, "u") for name in _NAMES]
+
+
+def _snapshot_docs(router):
+    """Canonical doc map for byte-identity comparison across a move."""
+    by_id = {}
+    for eid in _exp_ids():
+        for doc in router.read("trials", {"experiment": eid}):
+            by_id[doc["_id"]] = dumps_canonical(doc)
+        for doc in router.read("experiments", {"_id": eid}):
+            by_id[doc["_id"]] = dumps_canonical(doc)
+        for doc in router.read("telemetry", {"experiment": eid}):
+            by_id[doc["_id"]] = dumps_canonical(doc)
+    return by_id
+
+
+def _assert_exactly_once(router, servers):
+    """Every experiment lives on EXACTLY one shard, byte-complete, with no
+    leftover placement docs and clean audits on every shard."""
+    homes = {}
+    for index, conn in router.shard_connections():
+        assert conn.read(PLACEMENT_COLLECTION, {}) == []
+        for doc in conn.read("experiments", {}):
+            assert doc["_id"] not in homes, (
+                f"experiment {doc['_id']} on BOTH shard {homes[doc['_id']]} "
+                f"and shard {index}"
+            )
+            homes[doc["_id"]] = index
+            assert index == router.shard_for(doc["_id"])
+            trials = conn.read("trials", {"experiment": doc["_id"]})
+            assert len(trials) == TRIALS_PER_EXP
+        reports = audit_storage(DocumentStorage(conn), lost_timeout=3600.0)
+        assert all(r.ok for r in reports), [r.violations for r in reports]
+    assert len(homes) == N_EXPERIMENTS
+
+
+def test_plan_diff_and_full_migration_is_byte_identical(topology):
+    router, spec4, servers = topology
+    before = _snapshot_docs(router)
+    n_before = router.count("trials", {})
+    router.set_topology(spec4)
+    rebalancer = Rebalancer(router, fence_grace=0.25)
+    plan = rebalancer.plan()
+    assert plan.total == N_EXPERIMENTS and not plan.strays
+    # ~1/N: adding one of four shards moves roughly a quarter of the keys
+    # (hash variance on 12 experiments is wide — bound it loosely).
+    assert plan.move_fraction <= 2.5 / 4
+    rebalancer.run(plan)
+    assert router.count("trials", {}) == n_before
+    assert _snapshot_docs(router) == before, "documents changed across the move"
+    _assert_exactly_once(router, servers)
+    # Idempotent: a second run finds nothing to do.
+    again = Rebalancer(router, fence_grace=0).plan()
+    assert not again.moves and not again.strays
+
+
+@pytest.mark.parametrize("crash_stage", ["after_copy", "after_flip"])
+def test_crash_resume_is_exactly_once(topology, crash_stage):
+    """Kill the migrator after copy-before-flip and after flip-before-
+    delete; rerun; assert exactly-once placement, byte-identical docs,
+    clean audits on BOTH shards."""
+    router, spec4, servers = topology
+    before = _snapshot_docs(router)
+    router.set_topology(spec4)
+
+    crashed = {"done": False}
+
+    def crash_once(stage, exp_id):
+        if stage == crash_stage and not crashed["done"]:
+            crashed["done"] = True
+            raise _Crash(f"injected crash {stage} for {exp_id}")
+
+    wounded = Rebalancer(router, fence_grace=0.25, crash_at=crash_once)
+    plan = wounded.plan()
+    assert plan.moves, "fixture guarantees movers"
+    with pytest.raises(_Crash):
+        wounded.run(plan)
+    # Mid-crash the data must still be reachable THROUGH the router
+    # (placement override or ring, depending on where it died) once the
+    # fence clears — but first, resume and finish.
+    resumed = Rebalancer(router, fence_grace=0.25)
+    resumed.run()
+    assert _snapshot_docs(router) == before
+    _assert_exactly_once(router, servers)
+
+
+def test_fenced_experiment_holds_ops_with_a_transient_error(topology):
+    router, spec4, servers = topology
+    router.set_topology(spec4)
+    plan = Rebalancer(router, fence_grace=0).plan()
+    assert plan.moves, "fixture guarantees movers"
+    move = plan.moves[0]
+    dst_conn = dict(router.shard_connections())[move.dst_index]
+    dst_conn.write(
+        PLACEMENT_COLLECTION,
+        {
+            "_id": placement_doc_id(move.exp_id),
+            "experiment": move.exp_id,
+            "state": "fenced",
+            "shard": router._shards[move.src_index].identity,
+            "ts": time.time(),
+        },
+    )
+    from orion_tpu.storage.retry import is_transient
+
+    with pytest.raises(DatabaseError) as err:
+        router.read("trials", {"experiment": move.exp_id})
+    assert "fenced" in str(err.value)
+    assert is_transient(err.value), "fence must be retriable, not fatal"
+    assert getattr(err.value, "maybe_applied", True) is False
+    # Lifting the fence (back to the pinned state the migrator would
+    # restore on abort) heals immediately: fenced lookups are never
+    # cached, so the very next op re-reads and routes to the source.
+    dst_conn.write(
+        PLACEMENT_COLLECTION,
+        {"state": "pinned"},
+        query={"_id": placement_doc_id(move.exp_id)},
+    )
+    docs = router.read("trials", {"experiment": move.exp_id})
+    assert len(docs) == TRIALS_PER_EXP
+
+
+def test_placement_cache_ttl_and_invalidate_on_miss(topology):
+    """A router keeps routing by a cached override until its TTL expires
+    OR an override-routed read comes back empty (the post-delete stale
+    cache) — then it re-reads and heals.  Ring-routed empties invalidate
+    nothing (a fresh experiment polls empty forever at zero extra cost)."""
+    router, spec4, servers = topology
+    router.set_topology(spec4)
+    plan = Rebalancer(router, fence_grace=0).plan()
+    assert plan.moves, "fixture guarantees movers"
+    move = plan.moves[0]
+    conns = dict(router.shard_connections())
+    src_identity = router._shards[move.src_index].identity
+    # Pin the experiment to its source (what the migrator's phase 1 does).
+    conns[move.dst_index].write(
+        PLACEMENT_COLLECTION,
+        {
+            "_id": placement_doc_id(move.exp_id),
+            "experiment": move.exp_id,
+            "state": "pinned",
+            "shard": src_identity,
+            "ts": time.time(),
+        },
+    )
+    docs = router.read("trials", {"experiment": move.exp_id})
+    assert len(docs) == TRIALS_PER_EXP  # routed to the SOURCE via override
+    # Simulate the migrator finishing behind this router's back: move the
+    # docs and drop the override while the cache still points at src.
+    src, dst = conns[move.src_index], conns[move.dst_index]
+    for collection in ("trials", "telemetry"):
+        for doc in src.read(collection, {"experiment": move.exp_id}):
+            dst.write(collection, doc)
+        src.remove(collection, {"experiment": move.exp_id})
+    for doc in src.read("experiments", {"_id": move.exp_id}):
+        dst.write("experiments", doc)
+    src.remove("experiments", {"_id": move.exp_id})
+    dst.remove(PLACEMENT_COLLECTION, {"_id": placement_doc_id(move.exp_id)})
+    # First read rides the stale cache entry -> src -> EMPTY -> entry is
+    # invalidated; the follow-up read re-reads placement and heals.
+    first = router.read("trials", {"experiment": move.exp_id})
+    healed = router.read("trials", {"experiment": move.exp_id})
+    assert first == [] and len(healed) == TRIALS_PER_EXP
