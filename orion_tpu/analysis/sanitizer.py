@@ -436,6 +436,9 @@ class Tsan:
         # singleton guard (both annotated shared cells).
         ("orion_tpu.devmem", None, "_lock", "devmem._lock"),
         ("orion_tpu.metrics", None, "_worker_lock", "metrics._worker_lock"),
+        # The doctor's last-published-summary slot (read by /healthz
+        # handler threads, written by the watchdog/CLI watch loop).
+        ("orion_tpu.diagnosis.watch", None, "_last_lock", "diagnosis._last_lock"),
     )
 
     def __init__(self):

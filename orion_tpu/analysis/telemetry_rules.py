@@ -597,10 +597,113 @@ class WireSpanWithoutTraceContext(Rule):
                     )
 
 
+#: Severity strings a doctor rule may declare (mirrors
+#: ``orion_tpu.diagnosis.engine.SEVERITIES`` — kept literal here so the
+#: lint engine never imports the diagnosis package it checks).
+_DOCTOR_SEVERITIES = frozenset({"info", "warn", "critical"})
+
+
+def _doctor_rule_class(node):
+    """True when ``node`` is a ClassDef subclassing ``DoctorRule`` (any
+    qualification — ``DoctorRule``, ``engine.DoctorRule``)."""
+    if not isinstance(node, ast.ClassDef):
+        return False
+    for base in node.bases:
+        name = dotted_name(base)
+        if name and name.split(".")[-1] == "DoctorRule":
+            return True
+    return False
+
+
+def _class_constant(node, attr):
+    """The ast.Constant assigned to ``attr`` directly in the class body,
+    or None (absent, or assigned a non-constant).  Both the plain and the
+    annotated spelling count — ``severity: str = "critical"`` is as
+    explicit a declaration as the bare assignment."""
+    for stmt in node.body:
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = (stmt.target,)
+            value = stmt.value
+        else:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id == attr:
+                return value if isinstance(value, ast.Constant) else None
+    return None
+
+
+class DoctorRuleDiscipline(Rule):
+    id = "TEL006"
+    name = "doctor-rule-discipline"
+    description = (
+        "Every DoctorRule subclass must DECLARE its severity (info|warn|"
+        "critical) and a non-empty runbook anchor as class constants — a "
+        "finding the report cannot rank, or whose runbook link resolves "
+        "nowhere, is noise — and its evaluate()/methods must not build "
+        "per-call computed metric keys (f-strings, concatenation): the "
+        "per-rule gauge name is minted once at class definition, the same "
+        "discipline TEL001 enforces in loops."
+    )
+
+    def check(self, module):
+        for node in ast.walk(module.tree):
+            if not _doctor_rule_class(node):
+                continue
+            severity = _class_constant(node, "severity")
+            if severity is None or severity.value not in _DOCTOR_SEVERITIES:
+                yield Diagnostic(
+                    module.path,
+                    node.lineno,
+                    node.col_offset,
+                    self.id,
+                    f"doctor rule {node.name} must declare "
+                    "severity = 'info'|'warn'|'critical' as a class "
+                    "constant (inherited or computed severities are not "
+                    "declarations)",
+                )
+            runbook = _class_constant(node, "runbook")
+            if runbook is None or not (
+                isinstance(runbook.value, str) and runbook.value
+            ):
+                yield Diagnostic(
+                    module.path,
+                    node.lineno,
+                    node.col_offset,
+                    self.id,
+                    f"doctor rule {node.name} must declare a non-empty "
+                    "runbook anchor (runbook = 'dxNNN-rule-name', resolved "
+                    "into docs/monitoring.md)",
+                )
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for call in ast.walk(fn):
+                    mutator = _telemetry_call(call)
+                    if mutator is None or not call.args:
+                        continue
+                    key = call.args[0]
+                    if isinstance(key, (ast.Constant, ast.Name, ast.Attribute)):
+                        continue
+                    yield Diagnostic(
+                        module.path,
+                        call.lineno,
+                        call.col_offset,
+                        self.id,
+                        f"TELEMETRY.{mutator}() with a computed metric key "
+                        f"inside doctor rule {node.name}.{fn.name}() — "
+                        "mint the name once at class definition "
+                        "(gauge_name) instead of per evaluation",
+                    )
+
+
 TELEMETRY_RULES = (
     DynamicKeyInLoop,
     UnmanagedSpan,
     AllocationOnDisabledPath,
     HealthEmissionOnDisabledPath,
     WireSpanWithoutTraceContext,
+    DoctorRuleDiscipline,
 )

@@ -128,6 +128,15 @@ def load_cli_config(args):
         os.environ.setdefault(
             "ORION_TPU_METRICS_PORT", str(int(config["metrics_port"]))
         )
+    # `doctor_interval:` rides the same channel as metrics_port: resolved
+    # to the env spelling (so `hunt --n-workers` children inherit it) and
+    # STARTED only where a worker loop runs (workon) — a read-only
+    # command must not spin a diagnosis thread just because the config
+    # names it.
+    if config.get("doctor_interval") is not None:
+        os.environ.setdefault(
+            "ORION_TPU_DOCTOR_INTERVAL", str(float(config["doctor_interval"]))
+        )
     return config
 
 

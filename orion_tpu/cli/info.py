@@ -87,16 +87,60 @@ def format_info(experiment, per_worker=False):
         out.append(_section("Performance"))
         out.extend(perf)
 
-    tele = _telemetry_section(experiment, per_worker=per_worker)
+    # ONE fetch per channel for the three sections below — the telemetry,
+    # health, and doctor blocks all read the same two collections, and a
+    # sharded store pays a fan-out per fetch.  Each fetch is guarded
+    # separately so one sick channel costs only its own sections.
+    metrics_docs = _fetch_guarded(experiment, "fetch_metrics")
+    health_docs = _fetch_guarded(experiment, "fetch_health")
+
+    tele = _telemetry_section(experiment, per_worker=per_worker, docs=metrics_docs)
     if tele:
         out.append(_section("Telemetry"))
         out.extend(tele)
 
-    health = _health_section(experiment, per_worker=per_worker)
+    health = _health_section(experiment, per_worker=per_worker, docs=health_docs)
     if health:
         out.append(_section("Health"))
         out.extend(health)
+
+    doctor = _doctor_line(experiment, metrics_docs, health_docs)
+    if doctor:
+        out.append(_section("Doctor"))
+        out.append(doctor)
     return "\n".join(out) + "\n"
+
+
+def _fetch_guarded(experiment, op):
+    """One storage-channel fetch, degraded to None on any failure (a
+    malformed doc or a sick store drops the dependent sections, never
+    takes down ``info``)."""
+    try:
+        return getattr(experiment.storage, op)(experiment)
+    except Exception:
+        return None
+
+
+def _doctor_line(experiment, metrics_docs, health_docs):
+    """The diagnosis badge (orion_tpu.diagnosis): one line leading with
+    the verdict, naming the firing rules — `orion-tpu doctor` is the full
+    report.  Reads the docs format_info already fetched (the full
+    snapshot_top assembly builds a regret curve and per-worker rows this
+    line would throw away).  Guarded like the telemetry/health sections."""
+    if metrics_docs is None and health_docs is None:
+        # Both fetches failed: no data is not "healthy" — drop the badge
+        # rather than print an OK verdict over nothing.
+        return None
+    try:
+        from orion_tpu.cli.top import _doctor_block, doctor_badge
+
+        return doctor_badge(
+            _doctor_block(
+                experiment, metrics_docs or [], health_docs or [], time.time()
+            )
+        )
+    except Exception:
+        return None
 
 
 def _perf_section(experiment):
@@ -152,7 +196,7 @@ def _snapshot_lines(snapshot):
     return lines
 
 
-def _telemetry_section(experiment, per_worker=False):
+def _telemetry_section(experiment, per_worker=False, docs=None):
     """The unified-telemetry block: per-op latency percentiles from the
     merged cross-worker histogram snapshots (orion_tpu.telemetry), plus
     the counters (jax retraces, storage transactions/wire requests/
@@ -161,13 +205,15 @@ def _telemetry_section(experiment, per_worker=False):
     ``ORION_TPU_TELEMETRY=1`` (or ``telemetry: true``).  ``per_worker``
     keeps each worker's snapshot separate instead of merging — the merged
     view's MAX-combined gauges say only that SOME worker lags, never which
-    one.  The WHOLE section is guarded, not just the fetch: a malformed
-    doc (third-party backend, corruption) must drop this block, never
-    take down ``info``."""
+    one.  ``docs`` lets format_info share one fetch across sections.  The
+    WHOLE section is guarded, not just the fetch: a malformed doc
+    (third-party backend, corruption) must drop this block, never take
+    down ``info``."""
     from orion_tpu.telemetry import merge_snapshots
 
     try:
-        docs = experiment.storage.fetch_metrics(experiment)
+        if docs is None:
+            docs = experiment.storage.fetch_metrics(experiment)
         if not docs:
             return []
         now = time.time()
@@ -222,14 +268,16 @@ def _flush_age_suffix(doc, now):
     return f" (last flush {age:g}s ago{marker})"
 
 
-def _health_section(experiment, per_worker=False):
+def _health_section(experiment, per_worker=False, docs=None):
     """The optimization-health block (orion_tpu.health): the fleet-wide
     incumbent over the recorded regret trajectory and, per worker, the
     latest per-round health record — GP marginal likelihood, lengthscale
-    spread, acquisition level, trust-region box, rung occupancy.  Guarded
+    spread, acquisition level, trust-region box, rung occupancy.
+    ``docs`` lets format_info share one fetch across sections.  Guarded
     like the telemetry block; empty when no hunt recorded health."""
     try:
-        docs = experiment.storage.fetch_health(experiment)
+        if docs is None:
+            docs = experiment.storage.fetch_health(experiment)
         if not docs:
             return []
         best = None

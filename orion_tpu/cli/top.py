@@ -243,6 +243,11 @@ def snapshot_top(experiment, now=None):
             else None
         )
 
+    # Doctor badge (orion_tpu.diagnosis): the same joined channels this
+    # snapshot already fetched, run through the diagnosis rule catalog —
+    # the dashboard leads with the verdict, not just the raw numbers.
+    doctor = _doctor_block(experiment, metrics_docs, health_docs, now)
+
     return {
         "experiment": experiment.name,
         "version": experiment.version,
@@ -255,7 +260,58 @@ def snapshot_top(experiment, now=None):
         },
         "regret_curve": curve,
         "health_records": len(health_docs),
+        "doctor": doctor,
     }
+
+
+def _doctor_block(experiment, metrics_docs, health_docs, now):
+    """Evaluate the doctor rules over the docs the snapshot already
+    fetched (no second storage pass per frame); degrades to None rather
+    than ever failing a dashboard frame."""
+    try:
+        from orion_tpu.diagnosis import Snapshot, run_rules
+        from orion_tpu.diagnosis.snapshot import probe_replication
+        from orion_tpu.telemetry import merge_snapshots
+
+        snapshot = Snapshot(
+            metrics=merge_snapshots(metrics_docs),
+            per_worker=metrics_docs,
+            health=health_docs,
+            replication=probe_replication(experiment.storage),
+            heartbeat=getattr(experiment, "heartbeat", None),
+            stale_after=STALE_AFTER,
+            now=now,
+        )
+        report = run_rules(snapshot)
+        return {
+            **report.summary(),
+            "findings": [
+                {
+                    "rule": f.rule_id,
+                    "severity": f.severity,
+                    "message": f.message,
+                }
+                for f in report.findings
+            ],
+        }
+    except Exception:  # pragma: no cover - a frame must render regardless
+        return None
+
+
+def doctor_badge(doctor):
+    """One-line doctor verdict for the top/info headers."""
+    if not doctor:
+        return None
+    if doctor["status"] == "ok":
+        return "doctor: OK"
+    rules = ", ".join(
+        sorted({f["rule"] for f in doctor.get("findings") or ()})
+    )
+    return (
+        f"doctor: {doctor['status'].upper()} "
+        f"(critical: {doctor['critical']}, warn: {doctor['warn']}, "
+        f"info: {doctor['info']}) [{rules}] — see `orion-tpu doctor`"
+    )
 
 
 def render_top(snap):
@@ -265,6 +321,9 @@ def render_top(snap):
         f"workers: {len(snap['workers'])}   "
         f"health records: {snap['health_records']}"
     ]
+    badge = doctor_badge(snap.get("doctor"))
+    if badge:
+        lines.append(badge)
     incumbent = snap["incumbent"]
     if incumbent["best_y"] is not None:
         lines.append(

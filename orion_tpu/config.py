@@ -109,6 +109,12 @@ DEFAULTS = {
     # exposition of the telemetry registry); None = no server.  The env
     # spelling is ORION_TPU_METRICS_PORT.
     "metrics_port": None,
+    # Self-diagnosis watchdog (orion_tpu.diagnosis, docs/monitoring.md
+    # "Diagnosis & runbook"): a positive number of seconds makes every
+    # workon loop run the doctor rule catalog at that interval, publishing
+    # findings as flight.alert events + doctor.findings.* gauges; None =
+    # no watchdog.  The env spelling is ORION_TPU_DOCTOR_INTERVAL.
+    "doctor_interval": None,
     # Suggest gateway (orion_tpu.serve, docs/serving.md): a worker-level
     # knob, never part of the stored experiment identity.  None = local
     # algorithm instance (the default); {"address": "host:port", optional

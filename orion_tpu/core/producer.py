@@ -639,6 +639,13 @@ class Producer:
             record["round"] = self._round_index
             record["registered"] = int(registered)
             record["time"] = time.time()
+            # Device-memory stamp (orion_tpu.devmem publishes the gauge,
+            # rate-limited): gauges are last-write-wins, so the health
+            # record is the ONLY stored time series — the doctor's
+            # memory-growth trend rule (DX044) reads it from here.
+            mem = TELEMETRY.gauge_value("memory.device_live_bytes")
+            if mem is not None:
+                record["mem_bytes"] = float(mem)
             return record
         except Exception:  # pragma: no cover - observability never breaks a run
             log.debug("could not build health record", exc_info=True)

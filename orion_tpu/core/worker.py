@@ -85,6 +85,16 @@ def workon(
     from orion_tpu.metrics import ensure_worker_metrics_server
 
     ensure_worker_metrics_server()
+    # Self-diagnosis watchdog (orion_tpu.diagnosis): when the
+    # ORION_TPU_DOCTOR_INTERVAL env var (or the `doctor_interval:` config
+    # key, resolved to the same spelling by cli/base.py) asks for one, a
+    # daemon thread periodically joins this experiment's telemetry planes,
+    # evaluates the doctor rule catalog, and publishes findings as
+    # `flight.alert` events + the doctor.findings.* gauges the /metrics
+    # and /healthz planes export.  None when not requested; never raises.
+    from orion_tpu.diagnosis.watch import maybe_start_watchdog
+
+    watchdog = maybe_start_watchdog(experiment)
     producer = Producer(experiment, max_idle_time=max_idle_time)
     consumer = Consumer(
         experiment, cmdline_parser, heartbeat_interval=heartbeat_interval
@@ -107,6 +117,8 @@ def workon(
             log.error("worker crashed; flight record written to %s", path)
         raise
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         # Final telemetry flush: the last round's spans/metrics (including
         # the closing producer.round span) would otherwise die with the
         # process instead of reaching the storage channel `orion-tpu

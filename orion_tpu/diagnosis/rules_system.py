@@ -1,0 +1,196 @@
+"""Systems-plane doctor rules (``DX00x``): device dispatch hygiene,
+worker liveness, the wall≈device budget, and serve-plane saturation.
+
+Each rule reads only signals the stack already emits (docs/monitoring.md
+names every one); thresholds live as class attributes so the seeded
+pathology fixtures in ``tests/unit/test_doctor.py`` can construct
+unambiguous extremes and the docs can quote the exact bar.
+"""
+
+from orion_tpu.diagnosis.engine import DoctorRule
+
+
+class RetraceStorm(DoctorRule):
+    id = "DX001"
+    name = "retrace-storm"
+    severity = "critical"
+    runbook = "dx001-retrace-storm"
+    description = (
+        "jax.retraces climbing round over round means a fused-step "
+        "signature fork: every produce round pays a synchronous XLA "
+        "recompile (tens of seconds on a real TPU) instead of a cache hit."
+    )
+
+    #: A healthy hunt pays a handful of compiles (initial signatures +
+    #: pow-2 bucket growths); a fork retraces per ROUND.  Both bars must
+    #: hold: enough rounds to judge, and retraces keeping pace with them.
+    MIN_ROUNDS = 10
+    MIN_RETRACES = 10
+    RETRACES_PER_ROUND = 0.5
+
+    def evaluate(self, snapshot):
+        rounds = snapshot.rounds()
+        retraces = snapshot.counter("jax.retraces")
+        if rounds >= self.MIN_ROUNDS and retraces >= max(
+            self.MIN_RETRACES, self.RETRACES_PER_ROUND * rounds
+        ):
+            yield self.finding(
+                f"{retraces} synchronous retraces over {rounds} rounds "
+                "(healthy: a handful total) — a static argument is forking "
+                "the fused-step signature every round",
+                value=retraces,
+            )
+
+
+class HeartbeatLag(DoctorRule):
+    id = "DX002"
+    name = "heartbeat-lag"
+    severity = "warn"
+    runbook = "dx002-heartbeat-lag"
+    description = (
+        "pacemaker.heartbeat_lag_s approaching the heartbeat threshold: "
+        "live reserved trials are about to be swept as lost and re-run."
+    )
+
+    #: Fire at half the sweep threshold — early enough to act, late
+    #: enough that ordinary scheduling jitter stays quiet.
+    LAG_FRACTION = 0.5
+    DEFAULT_HEARTBEAT = 120.0
+
+    def evaluate(self, snapshot):
+        lag = snapshot.gauge("pacemaker.heartbeat_lag_s")
+        if lag is None:
+            return
+        heartbeat = float(snapshot.heartbeat or self.DEFAULT_HEARTBEAT)
+        if lag > self.LAG_FRACTION * heartbeat:
+            yield self.finding(
+                f"worst heartbeat lag {lag:.1f}s exceeds "
+                f"{self.LAG_FRACTION:g}x the {heartbeat:g}s sweep threshold "
+                "— reserved trials risk being swept as lost (gauges merge "
+                "by MAX, so this is the worst worker's number)",
+                value=lag,
+            )
+
+
+class StaleWorker(DoctorRule):
+    id = "DX003"
+    name = "stale-worker"
+    severity = "warn"
+    runbook = "dx003-stale-worker"
+    description = (
+        "a worker stopped flushing metrics/health while the rest of the "
+        "fleet is live: crashed, hung, or partitioned — its MAX-merged "
+        "gauges are fossils."
+    )
+
+    def evaluate(self, snapshot):
+        ages = snapshot.worker_ages()
+        if len(ages) < 2:
+            return
+        freshest = min(ages.values())
+        # The "fleet is live" gate: when EVERY worker is quiet the hunt
+        # ended (or the store is an archive) — that is not a stale-worker
+        # pathology, and firing on finished runs would make one-shot
+        # diagnosis over old experiments permanently noisy.
+        if freshest > snapshot.stale_after:
+            return
+        stale = sorted(
+            worker
+            for worker, age in ages.items()
+            if age > snapshot.stale_after
+        )
+        if stale:
+            worst = max(ages[worker] for worker in stale)
+            yield self.finding(
+                f"{len(stale)} worker(s) stopped flushing for > "
+                f"{snapshot.stale_after:g}s while the fleet is live: "
+                f"{', '.join(stale)}",
+                value=worst,
+                # Subject = WHICH workers: another worker going quiet is
+                # a new alert; the same set aging further is not.
+                subject=tuple(stale),
+            )
+
+
+class HostBudgetBreach(DoctorRule):
+    id = "DX004"
+    name = "host-budget-breach"
+    severity = "warn"
+    runbook = "dx004-host-budget-breach"
+    description = (
+        "the mean producer round runs far longer than the mean device "
+        "window: host work (codec, storage, Python) dominates the round "
+        "again — the wall-=-device contract is regressing."
+    )
+
+    #: Mean producer.round vs mean device.dispatch.  The device window
+    #: deliberately OVERLAPS host work (the pipelined commit), so a
+    #: healthy round's wall ≈ its window; 3x is well past overlap slack.
+    FACTOR = 3.0
+    MIN_SAMPLES = 4
+
+    def evaluate(self, snapshot):
+        round_mean = snapshot.histogram_mean("producer.round")
+        device_mean = snapshot.histogram_mean("device.dispatch")
+        if round_mean is None or device_mean is None or device_mean <= 0:
+            return
+        if (
+            int(snapshot.histogram("producer.round").get("count", 0))
+            < self.MIN_SAMPLES
+        ):
+            return
+        if round_mean > self.FACTOR * device_mean:
+            yield self.finding(
+                f"mean round {round_mean * 1e3:.1f}ms vs mean device window "
+                f"{device_mean * 1e3:.1f}ms (> {self.FACTOR:g}x): the round "
+                "is host-dominated — see breakdown_ms / `orion-tpu trace "
+                "--attribute` for which stage grew",
+                value=round_mean / device_mean,
+            )
+
+
+class ServeQueueSaturation(DoctorRule):
+    id = "DX005"
+    name = "serve-queue-saturation"
+    severity = "warn"
+    runbook = "dx005-serve-queue-saturation"
+    description = (
+        "the suggest gateway's admission queue is backing up or tenants "
+        "are being told to retry: the device (or the coalescing window) "
+        "can no longer keep up with offered load."
+    )
+
+    QUEUE_DEPTH = 64
+    BACKPRESSURE = 20
+
+    def evaluate(self, snapshot):
+        depth = snapshot.gauge("serve.queue_depth", default=0.0)
+        latest = snapshot.latest_health() or {}
+        depth = max(depth, float(latest.get("serve_queue_depth") or 0.0))
+        if depth >= self.QUEUE_DEPTH:
+            yield self.finding(
+                f"gateway admission queue depth {depth:g} >= "
+                f"{self.QUEUE_DEPTH} — suggests are waiting on the "
+                "dispatcher; widen max_width, shorten the window, or shard "
+                "the gateway",
+                value=depth,
+                subject="queue",
+            )
+        backpressure = snapshot.counter("serve.backpressure")
+        if backpressure >= self.BACKPRESSURE:
+            yield self.finding(
+                f"{backpressure} backpressure (RETRY-AFTER) replies — "
+                "tenants exceed their inflight quotas or the dispatcher "
+                "backlog timer is firing; raise quotas or add capacity",
+                value=backpressure,
+                subject="backpressure",
+            )
+
+
+SYSTEM_RULES = (
+    RetraceStorm,
+    HeartbeatLag,
+    StaleWorker,
+    HostBudgetBreach,
+    ServeQueueSaturation,
+)

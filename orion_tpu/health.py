@@ -148,7 +148,8 @@ class FlightRecorder:
         """Append one event ``{"kind", "ts", "pid", "args"?}`` to the ring.
 
         ``kind`` is a short dotted label (``"producer.round"``,
-        ``"storage.retry"``, ``"trial.status"``); ``args`` an optional
+        ``"storage.retry"``, ``"trial.status"``, the doctor's
+        ``"alert"``); ``args`` an optional
         small dict of context.  Oldest events past capacity are dropped —
         a flight recorder keeps the *recent* past."""
         if not self.enabled:
@@ -214,6 +215,17 @@ class FlightRecorder:
                 "events": len(events) + len(extra_events or ()),
                 "enabled": self.enabled,
             }
+            # The doctor's last published verdict (orion_tpu.diagnosis)
+            # rides the header: a crash dump that opens with "status:
+            # critical, DX021 firing" starts the post-mortem one step
+            # ahead of the raw event ring.  evaluate_local=False — a
+            # crash path must not pay a fresh diagnosis pass.
+            try:
+                from orion_tpu.diagnosis import doctor_summary
+
+                header["doctor"] = doctor_summary(evaluate_local=False)
+            except Exception:  # pragma: no cover - dumps must not fail
+                pass
             handle.write(json.dumps(header) + "\n")
             for event in events:
                 handle.write(json.dumps(event) + "\n")

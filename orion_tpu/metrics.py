@@ -49,6 +49,25 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 #: mint unbounded metric names — the exposition-format antipattern).
 _TENANT_RE = re.compile(r"^serve\.tenant\.(?P<tenant>.+)\.request$", re.DOTALL)
 
+#: Doctor findings gauges (``doctor.findings.<RULE>``, published by
+#: ``orion_tpu.diagnosis.watch.publish_report``) export as ONE
+#: ``orion_tpu_doctor_findings{rule,severity}`` family — rule ids are a
+#: closed registry, and the severity label comes from each rule's own
+#: declaration.
+_DOCTOR_RE = re.compile(r"^doctor\.findings\.(?P<rule>[A-Za-z0-9_]+)$")
+
+
+def _doctor_severities():
+    """rule id -> declared severity, lazily (the diagnosis package is a
+    lazy facade for the same reason).  Unknown ids label as ``unknown``
+    rather than dropping the sample."""
+    try:
+        from orion_tpu.diagnosis import rule_severities
+
+        return rule_severities()
+    except Exception:  # pragma: no cover - exposition must not break
+        return {}
+
 
 def sanitize_name(name):
     """Registry key -> Prometheus metric name component."""
@@ -110,10 +129,29 @@ def render_exposition(snapshot, prefix=PREFIX):
         metric = f"{prefix}{sanitize_name(name)}_total"
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {int(value)}")
+    doctor_rows = []
+    plain_gauges = []
     for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        match = _DOCTOR_RE.match(name)
+        if match:
+            doctor_rows.append((match.group("rule"), value))
+        else:
+            plain_gauges.append((name, value))
+    for name, value in plain_gauges:
         metric = f"{prefix}{sanitize_name(name)}"
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {_format_value(value)}")
+    if doctor_rows:
+        severities = _doctor_severities()
+        metric = f"{prefix}doctor_findings"
+        lines.append(f"# TYPE {metric} gauge")
+        for rule, value in doctor_rows:
+            severity = severities.get(rule, "unknown")
+            lines.append(
+                f'{metric}{{rule="{escape_label_value(rule)}",'
+                f'severity="{escape_label_value(severity)}"}} '
+                f"{_format_value(value)}"
+            )
     tenant_families = {}
     plain = []
     for name, hist in sorted((snapshot.get("histograms") or {}).items()):
@@ -222,6 +260,15 @@ _worker_server = None
 _worker_lock = threading.Lock()
 
 
+def _worker_healthz():
+    """The worker /healthz payload: liveness + the doctor summary block
+    (``orion_tpu.diagnosis``) — probes key off diagnosis, not bare
+    process liveness."""
+    from orion_tpu.diagnosis import doctor_summary
+
+    return {"ok": True, "doctor": doctor_summary()}
+
+
 def ensure_worker_metrics_server(port=None):
     """Start (once) the worker-side metrics server.
 
@@ -235,7 +282,12 @@ def ensure_worker_metrics_server(port=None):
       registry would serve an empty exposition forever);
     - ``hunt --n-workers N`` children all inherit ONE configured port —
       the first binds it, the rest fall back to an EPHEMERAL port (logged
-      with the bound address) instead of silently exporting nothing."""
+      with the bound address) instead of silently exporting nothing.
+
+    The worker's ``/healthz`` answers a DOCTOR summary block next to bare
+    liveness (status + critical/warn counts, from the watchdog's last
+    published report or a fresh local-registry pass) so a k8s-style probe
+    keys off diagnosis, not just an open socket."""
     global _worker_server
     if port is None:
         raw = os.environ.get("ORION_TPU_METRICS_PORT", "").strip()
@@ -251,10 +303,10 @@ def ensure_worker_metrics_server(port=None):
         if _worker_server is not None:
             return _worker_server
         try:
-            server = MetricsServer(port=int(port))
+            server = MetricsServer(port=int(port), healthz=_worker_healthz)
         except OSError as exc:
             try:
-                server = MetricsServer(port=0)
+                server = MetricsServer(port=0, healthz=_worker_healthz)
                 log.warning(
                     "metrics port %s unavailable (%s); falling back to an "
                     "ephemeral port", port, exc,

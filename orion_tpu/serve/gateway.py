@@ -305,17 +305,24 @@ class GatewayServer(socketserver.ThreadingTCPServer):
 
     def _healthz_snapshot(self):
         """The /healthz payload: liveness plus the two saturation signals
-        an external prober needs (bounded queue depth, hosted tenants).
-        Runs on the metrics server's handler threads — the tenant-table
-        read rides the gateway lock like every other cross-thread read."""
+        an external prober needs (bounded queue depth, hosted tenants),
+        plus the DOCTOR summary block (orion_tpu.diagnosis — a fresh pass
+        over this process's registry: queue saturation, backpressure,
+        retrace storms all read from local counters) so k8s-style probes
+        key off diagnosis, not bare socket liveness.  Runs on the metrics
+        server's handler threads — the tenant-table read rides the
+        gateway lock like every other cross-thread read."""
         with self._lock:
             TSAN.read("GatewayServer._tenants", self)
             tenants = len(self._tenants)
+        from orion_tpu.diagnosis import doctor_summary
+
         return {
             "ok": True,
             "queue_depth": self._queue.qsize(),
             "tenants": tenants,
             "stopping": self._stop.is_set(),
+            "doctor": doctor_summary(),
         }
 
     # --- lifecycle -----------------------------------------------------------

@@ -259,17 +259,47 @@ class SoakTopology:
             shard.stop()
 
 
+def _spread_names(router, n_experiments, n_shards):
+    """Deterministic soak experiment names, greedily SPREAD across the
+    ring: shard identities carry per-run ephemeral ports, so the fixed
+    ``soak-{e}`` names can (rarely) all hash onto one shard — and an
+    all-on-the-victim draw starves the chaos legs' signals (no traffic
+    ever touches a killed replica, so the replica-failover gate can
+    never fire).  Per slot, the candidate whose ring home currently
+    holds the fewest experiments wins; pure function of the ring, so
+    every caller agrees on placement.  Returns ``(names, loads)`` —
+    the per-shard counts are the placement truth, computed ONCE."""
+    from orion_tpu.core.experiment import experiment_id
+
+    names = []
+    loads = {index: 0 for index in range(n_shards)}
+    for e in range(n_experiments):
+        candidates = [f"soak-{e}"] + [
+            f"soak-{e}-{suffix}" for suffix in "abcdefghijk"
+        ]
+        best_name, best_home = None, None
+        for name in candidates:
+            home = router.shard_for(experiment_id(name, 1, "soak"))
+            if best_home is None or loads[home] < loads[best_home]:
+                best_name, best_home = name, home
+        names.append(best_name)
+        loads[best_home] += 1
+    return names, loads
+
+
+def soak_experiment_names(router, n_experiments, n_shards):
+    """The spread names alone — what ``drive_soak`` creates."""
+    names, _loads = _spread_names(router, n_experiments, n_shards)
+    return names
+
+
 def busiest_shard(topology, router, n_experiments):
     """Shard index the ring gave the most soak experiments — the
     kill-primary chaos legs target it, so promotion must heal a shard
-    under live write load, never an idle corner."""
-    from orion_tpu.core.experiment import experiment_id
-
-    counts = {shard.index: 0 for shard in topology.shards}
-    for e in range(n_experiments):
-        owner = router.shard_for(experiment_id(f"soak-{e}", 1, "soak"))
-        counts[owner] = counts.get(owner, 0) + 1
-    return max(counts, key=lambda index: counts[index])
+    under live write load, never an idle corner.  Reads the load map the
+    name spreading already computed (one placement truth, not two)."""
+    _names, loads = _spread_names(router, n_experiments, len(topology.shards))
+    return max(loads, key=lambda index: loads[index])
 
 
 def grow_and_rebalance(topology, storages, fence_grace=0.3,
@@ -433,8 +463,10 @@ def drive_soak(
 
     # --- experiments ---------------------------------------------------------
     exp_ids = []
-    for e in range(n_experiments):
-        name = f"soak-{e}"
+    names = soak_experiment_names(
+        storages[0].db, n_experiments, len(topology.shards)
+    )
+    for e, name in enumerate(names):
         config = {
             "_id": experiment_id(name, 1, "soak"),
             "name": name,

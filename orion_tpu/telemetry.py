@@ -313,6 +313,16 @@ class Telemetry:
             TSAN.write("Telemetry._metrics", self)
             self._gauges[name] = float(value)
 
+    def gauge_value(self, name, default=None):
+        """Current value of gauge ``name`` (``default`` when never set).
+        In-process reader, companion to :meth:`counter_value` — the
+        producer stamps the device-memory gauge into each round's health
+        record through this, so the doctor's trend rules get a stored
+        time series out of a last-write-wins gauge."""
+        with self._lock:
+            TSAN.read("Telemetry._metrics", self)
+            return self._gauges.get(name, default)
+
     def observe(self, name, seconds):
         """Record one duration sample into histogram ``name``."""
         if not self.enabled:

@@ -1,0 +1,74 @@
+"""TEL006 fixtures: doctor-rule declaration + metric-key discipline.
+
+Bad shapes: a DoctorRule subclass without a declared severity, one with a
+bogus severity, one without a runbook anchor, and an evaluate() minting a
+per-call computed gauge key.  Good shape: explicit severity + runbook,
+the gauge name read from the class constant.
+"""
+
+from orion_tpu.diagnosis.engine import DoctorRule
+from orion_tpu.telemetry import TELEMETRY
+
+
+class MissingSeverity(DoctorRule):  # expect: TEL006
+    id = "DX900"
+    name = "missing-severity"
+    runbook = "dx900-missing-severity"
+
+    def evaluate(self, snapshot):
+        return ()
+
+
+class BogusSeverity(DoctorRule):  # expect: TEL006
+    id = "DX901"
+    name = "bogus-severity"
+    severity = "fatal"
+    runbook = "dx901-bogus-severity"
+
+    def evaluate(self, snapshot):
+        return ()
+
+
+class MissingRunbook(DoctorRule):  # expect: TEL006
+    id = "DX902"
+    name = "missing-runbook"
+    severity = "warn"
+
+    def evaluate(self, snapshot):
+        return ()
+
+
+class ComputedKey(DoctorRule):
+    id = "DX903"
+    name = "computed-key"
+    severity = "warn"
+    runbook = "dx903-computed-key"
+
+    def evaluate(self, snapshot):
+        # The key is rebuilt (and re-hashed) on EVERY diagnosis pass.
+        TELEMETRY.set_gauge("doctor.findings." + self.id, 1)  # expect: TEL006
+        return ()
+
+
+class GoodRule(DoctorRule):
+    id = "DX904"
+    name = "good-rule"
+    severity = "critical"
+    runbook = "dx904-good-rule"
+
+    def evaluate(self, snapshot):
+        # Reading the class-minted name is the sanctioned form.
+        if TELEMETRY.enabled:
+            TELEMETRY.set_gauge(self.gauge_name, 0)
+        return ()
+
+
+class AnnotatedGoodRule(DoctorRule):
+    id = "DX905"
+    name = "annotated-good-rule"
+    # The annotated spelling is as explicit a declaration as the bare one.
+    severity: str = "warn"
+    runbook: str = "dx905-annotated-good-rule"
+
+    def evaluate(self, snapshot):
+        return ()

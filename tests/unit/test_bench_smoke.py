@@ -69,9 +69,18 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     assert proc.returncode == 0, proc.stderr[-2000:]
     payload = json.loads(proc.stdout.strip().splitlines()[-1])
     assert payload["smoke"] is True
+    # Cross-run joinability (ISSUE 15): every payload carries its schema
+    # version so BENCH_history.jsonl records can be joined honestly.
+    assert payload["schema_version"] >= 2
     # The --smoke preflight self-lints the tree before timing anything:
     # bench numbers must never be taken on a contract-violating tree.
     assert payload["lint_violations"] == 0
+    # The self-diagnosis gate (orion-tpu doctor over the bench's own
+    # healthy phases): bench.py hard-asserts zero CRITICAL findings
+    # (SystemExit) before the seeded-chaos legs; this pins the payload.
+    assert payload["doctor_critical"] == 0
+    assert payload["doctor"]["critical"] == 0
+    assert payload["doctor"]["status"] in ("ok", "warn")
     # The serve leg ran under the runtime concurrency sanitizer (orion-tpu
     # tsan): zero observed data races and zero lock-order cycles is a hard
     # assert inside bench.py; this pins the payload field on top.

@@ -1,0 +1,820 @@
+"""`orion-tpu doctor` tests: the seeded-pathology fixture table (every
+registered rule has a FIRING snapshot that trips exactly its own rule at
+its declared severity, and a QUIET snapshot that stays silent), the
+registry-completeness scan (every rule covered by a fixture, every
+runbook anchor resolving into docs/monitoring.md — same discipline as the
+lint-rule coverage scan), watch-mode alert dedup, the exit-code contract,
+the findings gauge family on the /metrics plane, and the /healthz doctor
+blocks.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+from orion_tpu.diagnosis import (
+    Snapshot,
+    default_rules,
+    doctor_catalog,
+    run_rules,
+)
+
+NOW = 1_000_000.0
+
+
+def _metrics(counters=None, gauges=None, histograms=None):
+    return {
+        "counters": counters or {},
+        "gauges": gauges or {},
+        "histograms": histograms or {},
+    }
+
+
+def _hist(count, mean_s):
+    buckets = [0] * 48
+    buckets[20] = count
+    return {
+        "buckets": buckets,
+        "count": count,
+        "sum": mean_s * count,
+        "min": mean_s,
+        "max": mean_s,
+    }
+
+
+def _health(n, **fields):
+    """n records with shared fields; callables get the record index."""
+    records = []
+    for i in range(n):
+        record = {"round": i + 1, "time": NOW - (n - i)}
+        for key, value in fields.items():
+            record[key] = value(i) if callable(value) else value
+        records.append(record)
+    return records
+
+
+def _replication(max_lags=None, primary_error=None):
+    probe = []
+    for index, lag in enumerate(max_lags or [0]):
+        entry = {"index": index, "primary": f"h:{7000 + index}", "max_lag": lag}
+        if primary_error is not None and index == 0:
+            entry["error"] = primary_error
+            entry.pop("max_lag")
+        probe.append(entry)
+    return probe
+
+
+#: rule id -> (firing snapshot, quiet snapshot).  The firing snapshot is
+#: the seeded pathology (ISSUE 15 acceptance: retrace storm, replication
+#: lag growth, heartbeat gap, GP flatline, regret stagnation, memory
+#: growth, ...) and must trip EXACTLY its own rule; the quiet snapshot is
+#: the same signal plane in a healthy state.
+FIXTURES = {
+    "DX001": (
+        Snapshot(
+            metrics=_metrics(
+                counters={"jax.retraces": 30},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={"jax.retraces": 4},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+    ),
+    "DX002": (
+        Snapshot(
+            metrics=_metrics(gauges={"pacemaker.heartbeat_lag_s": 80.0}),
+            heartbeat=120.0,
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(gauges={"pacemaker.heartbeat_lag_s": 2.0}),
+            heartbeat=120.0,
+            now=NOW,
+        ),
+    ),
+    "DX003": (
+        Snapshot(
+            per_worker=[
+                {"worker": "fresh:1", "time": NOW - 1.0},
+                {"worker": "gone:2", "time": NOW - 300.0},
+            ],
+            now=NOW,
+        ),
+        # Every worker quiet = the hunt ended, not a stale worker.
+        Snapshot(
+            per_worker=[
+                {"worker": "a:1", "time": NOW - 3600.0},
+                {"worker": "b:2", "time": NOW - 3600.0},
+            ],
+            now=NOW,
+        ),
+    ),
+    "DX004": (
+        Snapshot(
+            metrics=_metrics(
+                histograms={
+                    "producer.round": _hist(10, 0.100),
+                    "device.dispatch": _hist(10, 0.010),
+                }
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                histograms={
+                    "producer.round": _hist(10, 0.012),
+                    "device.dispatch": _hist(10, 0.010),
+                }
+            ),
+            now=NOW,
+        ),
+    ),
+    "DX005": (
+        Snapshot(
+            metrics=_metrics(gauges={"serve.queue_depth": 128.0}),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={"serve.backpressure": 2},
+                gauges={"serve.queue_depth": 3.0},
+            ),
+            now=NOW,
+        ),
+    ),
+    "DX020": (
+        Snapshot(
+            metrics=_metrics(
+                counters={"storage.retries": 200},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={"storage.retries": 10},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+    ),
+    "DX021": (
+        Snapshot(metrics=_metrics(counters={"storage.gave_up": 1}), now=NOW),
+        Snapshot(metrics=_metrics(counters={"storage.gave_up": 0}), now=NOW),
+    ),
+    "DX022": (
+        Snapshot(
+            metrics=_metrics(
+                counters={"storage.network.reconnects": 40},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={"storage.network.reconnects": 3},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+    ),
+    "DX023": (
+        # Lag growing probe over probe (the watch-accumulated series).
+        Snapshot(
+            replication_series=[
+                _replication([0]),
+                _replication([4]),
+                _replication([9]),
+                _replication([15]),
+            ],
+            now=NOW,
+        ),
+        Snapshot(
+            replication_series=[
+                _replication([2]),
+                _replication([1]),
+                _replication([2]),
+                _replication([0]),
+            ],
+            now=NOW,
+        ),
+    ),
+    "DX024": (
+        Snapshot(
+            metrics=_metrics(counters={"storage.shard.fenced_writes": 12}),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(counters={"storage.shard.fenced_writes": 2}),
+            now=NOW,
+        ),
+    ),
+    "DX025": (
+        Snapshot(
+            replication=_replication([0, 0], primary_error="ConnectionRefusedError"),
+            now=NOW,
+        ),
+        Snapshot(replication=_replication([0, 0]), now=NOW),
+    ),
+    "DX040": (
+        Snapshot(health=_health(3, gp_mll=float("nan"), best_y=0.5), now=NOW),
+        Snapshot(
+            health=_health(3, gp_mll=-0.2, gp_noise=1e-3, gp_ls_max=0.8),
+            now=NOW,
+        ),
+    ),
+    "DX041": (
+        Snapshot(health=_health(5, acq_ei_max=1e-12, gp_mll=-0.2), now=NOW),
+        Snapshot(health=_health(5, acq_ei_max=1e-3, gp_mll=-0.2), now=NOW),
+    ),
+    "DX042": (
+        Snapshot(health=_health(4, q_unique_frac=0.2), now=NOW),
+        Snapshot(health=_health(4, q_unique_frac=0.96), now=NOW),
+    ),
+    "DX043": (
+        Snapshot(health=_health(12, best_y=0.5), now=NOW),
+        Snapshot(health=_health(12, best_y=lambda i: 1.0 / (i + 1)), now=NOW),
+    ),
+    "DX044": (
+        Snapshot(
+            health=_health(16, mem_bytes=lambda i: 1e6 * (1 + i), best_y=None),
+            now=NOW,
+        ),
+        Snapshot(health=_health(16, mem_bytes=5e6), now=NOW),
+    ),
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_firing_fixture_trips_exactly_its_own_rule(rule_id):
+    firing, _quiet = FIXTURES[rule_id]
+    report = run_rules(firing)
+    fired = {f.rule_id for f in report.findings}
+    assert fired == {rule_id}, (
+        f"{rule_id} fixture fired {fired or 'nothing'} instead of exactly "
+        f"itself: {[f.format() for f in report.findings]}"
+    )
+    declared = {r.id: r.severity for r in default_rules()}[rule_id]
+    assert all(f.severity == declared for f in report.findings)
+
+
+@pytest.mark.parametrize("rule_id", sorted(FIXTURES))
+def test_quiet_fixture_stays_quiet(rule_id):
+    _firing, quiet = FIXTURES[rule_id]
+    report = run_rules(quiet)
+    assert rule_id not in {f.rule_id for f in report.findings}, (
+        f"{rule_id} fired on its healthy fixture: "
+        f"{[f.format() for f in report.findings]}"
+    )
+
+
+def test_every_registered_rule_has_a_fixture_and_a_resolvable_runbook(repo_root):
+    """The completeness scan (lint-rule coverage-scan discipline): a rule
+    added without a firing fixture, or whose runbook anchor points at no
+    heading in docs/monitoring.md, fails tier-1."""
+    catalog = doctor_catalog()
+    assert catalog, "no doctor rules registered"
+    with open(os.path.join(repo_root, "docs", "monitoring.md")) as handle:
+        doc = handle.read()
+    anchors = set()
+    for line in doc.splitlines():
+        if line.startswith("#"):
+            title = line.lstrip("#").strip().lower()
+            slug = re.sub(r"[^a-z0-9 _-]", "", title)
+            anchors.add(re.sub(r"\s+", "-", slug.strip()))
+    for rule_id, name, severity, runbook, description in catalog:
+        assert rule_id in FIXTURES, f"rule {rule_id} has no firing fixture"
+        assert severity in ("info", "warn", "critical")
+        assert runbook in anchors, (
+            f"rule {rule_id} runbook anchor {runbook!r} resolves to no "
+            "heading in docs/monitoring.md"
+        )
+        assert description
+    # The engine's broken-rule marker documents itself too.
+    assert "dx999-broken-rule" in anchors
+
+
+def test_healthy_empty_snapshot_reports_ok():
+    report = run_rules(Snapshot(now=NOW))
+    assert report.status == "ok" and report.exit_code == 0
+    assert report.findings == []
+    # Zeros for every registered rule (plus the engine's broken-rule
+    # marker — a crashing rule must be scrapeable): publishing clears
+    # resolved gauges.
+    assert set(report.rule_counts) == {r.id for r in default_rules()} | {"DX999"}
+    assert all(count == 0 for count in report.rule_counts.values())
+    assert report.gauge_names["DX999"] == "doctor.findings.DX999"
+
+
+def test_severity_ordering_and_exit_code():
+    firing_storm, _ = FIXTURES["DX001"]
+    firing_stagnation, _ = FIXTURES["DX043"]
+    merged = Snapshot(
+        metrics=firing_storm.metrics,
+        health=firing_stagnation.health,
+        now=NOW,
+    )
+    report = run_rules(merged)
+    severities = [f.severity for f in report.findings]
+    assert severities == sorted(
+        severities, key=lambda s: ("critical", "warn", "info").index(s)
+    )
+    assert report.exit_code == 1 and report.status == "critical"
+    # A warn/info-only report exits 0: warns are advice, not pages.
+    assert run_rules(firing_stagnation).exit_code == 0
+
+
+def test_alert_dedup_fires_once_then_realerts_after_clearing():
+    from orion_tpu.diagnosis.watch import AlertDeduper
+
+    firing, quiet = FIXTURES["DX021"]
+    deduper = AlertDeduper()
+    first = deduper.new_findings(run_rules(firing).findings)
+    assert [f.rule_id for f in first] == ["DX021"]
+    # Same condition persists -> no new alert.
+    assert deduper.new_findings(run_rules(firing).findings) == []
+    # Clears...
+    assert deduper.new_findings(run_rules(quiet).findings) == []
+    # ...and re-appears -> alerts again.
+    again = deduper.new_findings(run_rules(firing).findings)
+    assert [f.rule_id for f in again] == ["DX021"]
+
+
+def test_alert_dedup_is_immune_to_climbing_counter_values():
+    """The dedup keys on (rule, subject), never the message: a retry
+    spike whose counter climbs between watch passes must alert ONCE, not
+    re-alert every interval with fresh numbers."""
+    from orion_tpu.diagnosis.watch import AlertDeduper
+
+    def spike(retries):
+        return Snapshot(
+            metrics=_metrics(
+                counters={"storage.retries": retries},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        )
+
+    deduper = AlertDeduper()
+    first = deduper.new_findings(run_rules(spike(200)).findings)
+    assert [f.rule_id for f in first] == ["DX020"]
+    # The counter climbed — same condition, no new alert.
+    assert deduper.new_findings(run_rules(spike(350)).findings) == []
+    # Multi-subject rule: a NEW subject under the same rule IS new.
+    q = FIXTURES["DX005"][0]  # queue-depth finding
+    both = Snapshot(
+        metrics=_metrics(
+            counters={"serve.backpressure": 50},
+            gauges={"serve.queue_depth": 128.0},
+        ),
+        now=NOW,
+    )
+    deduper = AlertDeduper()
+    assert len(deduper.new_findings(run_rules(q).findings)) == 1
+    fresh = deduper.new_findings(run_rules(both).findings)
+    assert [f.subject for f in fresh] == ["backpressure"]
+
+
+def test_doctor_summary_expires_instead_of_serving_a_fossil():
+    """A watchdog whose passes started failing stops publishing; past the
+    TTL the slot must not answer the pre-outage verdict as current."""
+    from orion_tpu.diagnosis import doctor_summary, publish_report
+    from orion_tpu.diagnosis import watch as watch_mod
+    from orion_tpu.diagnosis.watch import _reset_last_summary
+
+    firing, _quiet = FIXTURES["DX021"]
+    _reset_last_summary()
+    try:
+        publish_report(run_rules(firing))
+        assert doctor_summary(evaluate_local=False)["status"] == "critical"
+        # Backdate the publish past the TTL: the stale verdict degrades
+        # to "unknown" (counts + age kept for the prober's benefit).
+        watch_mod._last_published -= watch_mod.SUMMARY_TTL_S + 1.0
+        stale = doctor_summary(evaluate_local=False)
+        assert stale["status"] == "unknown"
+        assert stale["critical"] == 1 and stale["age_s"] > watch_mod.SUMMARY_TTL_S
+    finally:
+        _reset_last_summary()
+
+
+def test_publish_report_sets_gauges_records_alerts_and_healthz_slot():
+    from orion_tpu import telemetry as tel
+    from orion_tpu.diagnosis import doctor_summary, publish_report
+    from orion_tpu.diagnosis.watch import _reset_last_summary
+    from orion_tpu.health import FLIGHT
+    from orion_tpu.storage.base import create_storage
+
+    storage = create_storage({"type": "memory"})
+    exp = storage.create_experiment({"name": "pub", "metadata": {"user": "u"}})
+    firing, _quiet = FIXTURES["DX021"]
+    report = run_rules(firing)
+    was_tel, was_flight = tel.TELEMETRY.enabled, FLIGHT.enabled
+    tel.TELEMETRY.enable()
+    FLIGHT.enable()
+    try:
+        tel.TELEMETRY.reset()
+        FLIGHT.clear()
+        _reset_last_summary()
+        publish_report(
+            report,
+            new_findings=report.findings,
+            storage=storage,
+            experiment=exp,
+        )
+        # Gauge family: firing rule at 1, every other registered rule at 0.
+        snapshot = tel.TELEMETRY.snapshot()
+        assert snapshot["gauges"]["doctor.findings.DX021"] == 1.0
+        assert snapshot["gauges"]["doctor.findings.DX001"] == 0.0
+        # flight.alert events reached BOTH the process ring and storage.
+        kinds = [e["kind"] for e in FLIGHT.events()]
+        assert "alert" in kinds
+        spans = storage.fetch_spans(exp)
+        alerts = [s for s in spans if s.get("name") == "flight.alert"]
+        assert len(alerts) == 1
+        assert alerts[0]["args"]["rule"] == "DX021"
+        assert alerts[0]["args"]["severity"] == "critical"
+        # The /healthz slot now answers from the published report (plus
+        # the freshness stamp a prober needs to judge it by).
+        summary = doctor_summary()
+        age = summary.pop("age_s")
+        assert summary == report.summary() and age >= 0.0
+        # Prometheus exposition renders the labeled doctor family.
+        from orion_tpu.metrics import render_exposition
+
+        text = render_exposition(snapshot)
+        assert (
+            'orion_tpu_doctor_findings{rule="DX021",severity="critical"} 1'
+            in text
+        )
+        assert (
+            'orion_tpu_doctor_findings{rule="DX001",severity="critical"} 0'
+            in text
+        )
+    finally:
+        tel.TELEMETRY.reset()
+        FLIGHT.clear()
+        _reset_last_summary()
+        if not was_tel:
+            tel.TELEMETRY.disable()
+        if not was_flight:
+            FLIGHT.disable()
+
+
+def _seed_storage(tmp_path, critical=False):
+    from orion_tpu.storage.base import create_storage
+
+    os.makedirs(str(tmp_path), exist_ok=True)
+    db_path = str(tmp_path / "doctor.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment(
+        {"name": "doc-exp", "metadata": {"user": "u"}}
+    )
+    counters = {"jax.retraces": 1}
+    if critical:
+        counters["storage.gave_up"] = 2
+    storage.record_metrics(
+        exp,
+        {"counters": counters, "gauges": {}, "histograms": {}},
+        worker="w:1",
+    )
+    for i in range(4):
+        storage.record_health(
+            exp,
+            {"round": i + 1, "best_y": 1.0 / (i + 1), "time": 100.0 + i},
+            worker="w:1",
+        )
+    return db_path
+
+
+def test_cli_exit_code_contract(tmp_path, capsys):
+    """orion-tpu doctor over a healthy store exits 0; a critical finding
+    (an exhausted retry policy) exits 1 — the automation contract."""
+    from orion_tpu.cli import main as cli_main
+
+    healthy = _seed_storage(tmp_path / "ok", critical=False)
+    rc = cli_main(["doctor", "-n", "doc-exp", "--storage-path", healthy])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "healthy: no findings" in out and "status: ok" in out
+
+    broken = _seed_storage(tmp_path / "bad", critical=True)
+    rc = cli_main(
+        ["doctor", "-n", "doc-exp", "--storage-path", broken, "--json"]
+    )
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["status"] == "critical"
+    assert [f["rule"] for f in payload["findings"]] == ["DX021"]
+    assert payload["findings"][0]["runbook"].startswith("docs/monitoring.md#")
+
+
+def test_cli_all_and_watch_iterations(tmp_path, capsys):
+    from orion_tpu.cli import main as cli_main
+
+    db_path = _seed_storage(tmp_path, critical=True)
+    rc = cli_main(["doctor", "--all", "--storage-path", db_path, "--json"])
+    assert rc == 1
+    reports = json.loads(capsys.readouterr().out)
+    assert isinstance(reports, list) and reports[0]["status"] == "critical"
+    # Watch mode with --iterations publishes alerts into the spans
+    # channel (flight.alert) exactly once across repeat passes.
+    rc = cli_main(
+        [
+            "doctor",
+            "-n",
+            "doc-exp",
+            "--storage-path",
+            db_path,
+            "--watch",
+            "--json",
+            "--iterations",
+            "2",
+            "-i",
+            "0.5",
+        ]
+    )
+    assert rc == 1
+    # The watch JSON stream carries the FULL findings per pass — the
+    # automation surface must say which rule fired where, not just that
+    # something did.
+    passes = [json.loads(line) for line in capsys.readouterr().out.splitlines()]
+    assert len(passes) == 2
+    for watch_pass in passes:
+        assert watch_pass["status"] == "critical"
+        report = watch_pass["experiments"][0]
+        assert report["experiment"] == "doc-exp v1"
+        assert [f["rule"] for f in report["findings"]] == ["DX021"]
+    from orion_tpu.storage.base import create_storage
+
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.fetch_experiments({"name": "doc-exp"})[0]
+    alerts = [
+        s
+        for s in storage.fetch_spans(exp)
+        if s.get("name") == "flight.alert"
+    ]
+    assert len(alerts) == 1, "watch mode must dedup repeat findings"
+
+
+def test_cli_list_rules(capsys):
+    from orion_tpu.cli import main as cli_main
+
+    rc = cli_main(["doctor", "--list-rules"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for rule_id, _name, severity, _runbook, _desc in doctor_catalog():
+        assert rule_id in out and f"[{severity}]" in out
+
+
+def test_top_badge_and_doctor_block(tmp_path):
+    from orion_tpu.cli.top import doctor_badge, snapshot_top
+    from orion_tpu.storage.base import create_storage
+
+    db_path = _seed_storage(tmp_path, critical=True)
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp_doc = storage.fetch_experiments({"name": "doc-exp"})[0]
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "doc-exp"
+            self.version = 1
+            self.id = exp_doc["_id"]
+
+    snap = snapshot_top(_Exp())
+    assert snap["doctor"]["status"] == "critical"
+    assert snap["doctor"]["findings"][0]["rule"] == "DX021"
+    badge = doctor_badge(snap["doctor"])
+    assert "CRITICAL" in badge and "DX021" in badge
+    from orion_tpu.cli.top import render_top
+
+    assert "doctor: CRITICAL" in render_top(snap)
+
+
+def test_watchdog_tick_publishes_and_dedups(tmp_path):
+    from orion_tpu.diagnosis.watch import DoctorWatchdog, _reset_last_summary
+    from orion_tpu.storage.base import create_storage
+
+    db_path = _seed_storage(tmp_path, critical=True)
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp_doc = storage.fetch_experiments({"name": "doc-exp"})[0]
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "doc-exp"
+            self.version = 1
+            self.id = exp_doc["_id"]
+            self.heartbeat = 120.0
+
+    from orion_tpu.health import FLIGHT
+
+    was_flight = FLIGHT.enabled
+    FLIGHT.enable()
+    try:
+        FLIGHT.clear()
+        _reset_last_summary()
+        watchdog = DoctorWatchdog(_Exp(), interval=60.0)
+        report = watchdog.tick()
+        assert report.status == "critical"
+        alerts = [e for e in FLIGHT.events() if e["kind"] == "alert"]
+        assert len(alerts) == 1
+        # Second tick: same condition, no new alert event.
+        watchdog.tick()
+        alerts = [e for e in FLIGHT.events() if e["kind"] == "alert"]
+        assert len(alerts) == 1
+        from orion_tpu.diagnosis import doctor_summary
+
+        assert doctor_summary()["status"] == "critical"
+    finally:
+        FLIGHT.clear()
+        _reset_last_summary()
+        if not was_flight:
+            FLIGHT.disable()
+
+
+def test_maybe_start_watchdog_env_knob(tmp_path, monkeypatch):
+    from orion_tpu.diagnosis.watch import maybe_start_watchdog
+    from orion_tpu.storage.base import create_storage
+
+    monkeypatch.delenv("ORION_TPU_DOCTOR_INTERVAL", raising=False)
+    assert maybe_start_watchdog(object()) is None
+    monkeypatch.setenv("ORION_TPU_DOCTOR_INTERVAL", "not-a-number")
+    assert maybe_start_watchdog(object()) is None
+    monkeypatch.setenv("ORION_TPU_DOCTOR_INTERVAL", "0")
+    assert maybe_start_watchdog(object()) is None
+
+    db_path = _seed_storage(tmp_path, critical=False)
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp_doc = storage.fetch_experiments({"name": "doc-exp"})[0]
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "doc-exp"
+            self.version = 1
+            self.id = exp_doc["_id"]
+
+    monkeypatch.setenv("ORION_TPU_DOCTOR_INTERVAL", "30")
+    watchdog = maybe_start_watchdog(_Exp())
+    try:
+        assert watchdog is not None and watchdog.interval == 30.0
+        assert watchdog._thread.is_alive()
+    finally:
+        watchdog.stop()
+    assert not watchdog._thread.is_alive()
+
+
+def test_worker_healthz_and_gateway_healthz_carry_doctor_block():
+    from orion_tpu.diagnosis.watch import _reset_last_summary
+    from orion_tpu.metrics import _worker_healthz
+
+    _reset_last_summary()
+    payload = _worker_healthz()
+    assert payload["ok"] is True
+    assert payload["doctor"]["status"] in ("ok", "warn", "critical", "unknown")
+    assert set(payload["doctor"]) >= {"status", "critical", "warn"}
+
+    from orion_tpu.serve.gateway import GatewayServer
+
+    server = GatewayServer(port=0)
+    server.serve_background()
+    try:
+        healthz = server._healthz_snapshot()
+        assert healthz["ok"] is True
+        assert set(healthz["doctor"]) >= {"status", "critical", "warn"}
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_local_snapshot_reads_the_process_registry():
+    from orion_tpu import telemetry as tel
+    from orion_tpu.diagnosis import local_snapshot
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    try:
+        tel.TELEMETRY.reset()
+        tel.TELEMETRY.count("storage.gave_up", 3)
+        report = run_rules(local_snapshot())
+        assert {f.rule_id for f in report.findings} == {"DX021"}
+    finally:
+        tel.TELEMETRY.reset()
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+
+
+def test_trend_detectors():
+    from orion_tpu.diagnosis.trend import ewma, relative_change, robust_slope
+
+    assert robust_slope([]) == 0.0 and robust_slope([5.0]) == 0.0
+    assert robust_slope([1, 2, 3, 4]) == pytest.approx(1.0)
+    # One outlier cannot flip the Theil-Sen sign (a least-squares fit
+    # over this series would report a positive slope).
+    assert robust_slope([10, 9, 8, 100, 6, 5, 4]) < 0
+    assert ewma([]) is None
+    assert ewma([2.0, 2.0, 2.0]) == pytest.approx(2.0)
+    assert relative_change([1.0, 2.0]) == pytest.approx(1.0)
+    assert relative_change([4.0]) == 0.0
+
+
+def test_producer_stamps_mem_bytes_into_health_records():
+    """The memory-growth trend rule needs a stored series: the producer
+    stamps the device-memory gauge into each round's health record."""
+    from orion_tpu import telemetry as tel
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.storage.base import create_storage
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    try:
+        tel.TELEMETRY.reset()
+        tel.TELEMETRY.set_gauge("memory.device_live_bytes", 1.5e6)
+        storage = create_storage({"type": "memory"})
+        experiment = build_experiment(
+            storage,
+            "mem-stamp",
+            priors={"x": "uniform(0, 1)"},
+            # An algorithm WITH a health_record (random search reports
+            # nothing, and the mem stamp rides the health record).
+            algorithms={
+                "tpu_bo": {"n_init": 2, "n_candidates": 16, "fit_steps": 2}
+            },
+            metadata={"user": "u"},
+        )
+        experiment.instantiate(seed=1)
+        producer = Producer(experiment)
+        producer.update()
+        producer.produce(2)
+        producer._flush_timings(force_metrics=True)
+        records = storage.fetch_health(experiment)
+        assert records, "no health record flushed"
+        # The stamp tracks the live gauge at record-build time (the
+        # flush's own devmem sample refreshes it, so the exact value
+        # moves) — what matters is that a per-round SERIES of real
+        # positive byte counts now exists in storage for DX044 to trend.
+        assert records[-1]["mem_bytes"] > 0
+    finally:
+        tel.TELEMETRY.reset()
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+
+
+def test_bench_history_hook(tmp_path):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_doctor_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "bench.py"),
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    payload = {
+        "schema_version": bench.BENCH_SCHEMA_VERSION,
+        "smoke": True,
+        "value": 123.0,
+        "regret_gate": {"pass": True},
+        "doctor_critical": 0,
+    }
+    # Smoke payloads append nowhere by default (tier-1 runs --smoke
+    # constantly; the committed series must not grow a line per CI run).
+    assert bench.append_bench_history(dict(payload)) is None
+    # An explicit path captures the compact joinable record.
+    history = tmp_path / "history.jsonl"
+    out = bench.append_bench_history(dict(payload), path=str(history))
+    assert out == str(history)
+    bench.append_bench_history(dict(payload, smoke=False), path=str(history))
+    lines = [json.loads(line) for line in history.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["schema_version"] == bench.BENCH_SCHEMA_VERSION
+    assert lines[0]["value"] == 123.0
+    assert lines[0]["regret_gate_pass"] is True
+    assert lines[0]["doctor_critical"] == 0
+    assert lines[1]["smoke"] is False
+
+
+def test_committed_bench_history_is_joinable(repo_root):
+    """The seeded cross-run series: every committed line parses, carries a
+    schema version, and the headline value column is populated."""
+    path = os.path.join(repo_root, "BENCH_history.jsonl")
+    lines = [
+        json.loads(line)
+        for line in open(path).read().splitlines()
+        if line.strip()
+    ]
+    assert len(lines) >= 5
+    for record in lines:
+        assert "schema_version" in record and record["schema_version"] >= 1
+        assert "value" in record
+    assert all(r["value"] is not None for r in lines)
