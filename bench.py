@@ -366,7 +366,13 @@ def bench_breakdown(rounds=4, q=Q, algo=None, n_hist=130):
     _observe(algo, X, _hartmann6_np(X))
     algo.suggest(q)  # compile
 
+    from orion_tpu.algo.tpu_bo import plan_prep_stats, reset_plan_prep_stats
     from orion_tpu.core.trial import TrialBatch
+
+    # Plan-prep cache accounting over the measured rounds only: the µs the
+    # per-signature cache saves inside the dispatch stage (statics dict +
+    # signature + cold-hypers rebuilt on a miss, reused on a hit).
+    reset_plan_prep_stats()
 
     stages = {k: [] for k in
               ("encode", "upload", "dispatch", "wait_transfer", "health",
@@ -398,7 +404,12 @@ def bench_breakdown(rounds=4, q=Q, algo=None, n_hist=130):
                                     t_health - t4, t5 - t_health,
                                     t6 - t5, t7 - t6)):
             stages[key].append(dt)
-    return {k: round(1e3 * float(np.median(v)), 3) for k, v in stages.items()}
+    out = {k: round(1e3 * float(np.median(v)), 3) for k, v in stages.items()}
+    # SAVINGS report like telemetry_us_saved, not a stage: the dispatch
+    # medians above already CONTAIN the cache-hit prep, so the saved µs must
+    # be excluded from every host_ms sum (test_bench_smoke pins this).
+    out["prep_us_saved"] = plan_prep_stats()["saved_us"]
+    return out
 
 
 def bench_telemetry_batching(samples_per_round=4, rounds=400):
@@ -840,7 +851,8 @@ def _json_payload(
     host_ms_per_round = round(
         sum(
             v for k, v in breakdown_ms.items()
-            if k not in ("wait_transfer", "storage_ms", "telemetry_us_saved")
+            if k not in ("wait_transfer", "storage_ms", "telemetry_us_saved",
+                         "prep_us_saved")
             and v is not None
         ),
         3,
@@ -982,7 +994,8 @@ def _assert_health_overhead(breakdown):
     health_ms = breakdown.get("health")
     round_ms = sum(
         v for k, v in breakdown.items()
-        if k not in ("storage_ms", "telemetry_us_saved") and v is not None
+        if k not in ("storage_ms", "telemetry_us_saved", "prep_us_saved")
+        and v is not None
     )
     assert health_ms is not None and round_ms > 0
     assert health_ms <= 0.01 * round_ms, (
@@ -1402,6 +1415,194 @@ def main_soak(n_workers=1000):
     print(json.dumps(payload))
 
 
+def bench_sharded(smoke=False):
+    """``--sharded``: the multichip suggest data path, measured.
+
+    Must run in a process whose backend already exposes the mesh devices
+    (real chips, or the virtual CPU mesh ``main_sharded`` re-execs into).
+    Three blocks, one JSON payload:
+
+    - ``bit_match``: one fused round on the full mesh vs the SAME plan
+      forced single-device — suggestion rows, GP state and health compared
+      bit for bit (the sharded gate's bit-match-or-fail contract).
+    - ``placement``: per-device byte fractions of a sharded candidate pool
+      (``sharding.placement_fractions``) — every mesh device must hold a
+      nonzero shard, or sharding has silently regressed to one chip.
+    - ``q_curve``: suggestions/sec sharded vs single-device across growing
+      q (the candidate pool scales with q).  On hosts without at least one
+      core/chip per mesh device (the CPU virtual mesh: N devices on one
+      core) the sharded/single ratio is reported but carries no speedup
+      meaning — ``parallel_capacity`` says which reading applies.
+    """
+    import os
+
+    import jax
+
+    from orion_tpu.algo.sharding import placement_fractions, shard_candidates
+    from orion_tpu.algo.tpu_bo import FusedPlan, run_fused_plan
+    from orion_tpu.space.dsl import build_space
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        raise SystemExit(
+            "bench.py --sharded needs a multi-device backend "
+            "(run via main_sharded for the virtual-mesh re-exec)"
+        )
+    d = 6
+    if smoke:
+        qs, n_candidates, fit_steps, n_hist = (8, 32), 512, 8, 24
+    else:
+        qs, n_candidates, fit_steps, n_hist = (1024, 4096, 16384), 16384, 40, 130
+    space = build_space({f"x{i}": "uniform(0, 1)" for i in range(d)})
+    rng = np.random.default_rng(SEED + 3)
+    X = rng.uniform(size=(n_hist, d)).astype(np.float32)
+    y = _hartmann6_np(X)
+
+    def fresh_algo(use_mesh):
+        from orion_tpu.algo.base import create_algo
+
+        algo = create_algo(
+            space,
+            {"tpu_bo": {"n_init": N_INIT, "n_candidates": n_candidates,
+                        "fit_steps": fit_steps, "prewarm": False,
+                        "use_mesh": use_mesh}},
+            seed=SEED + 3,
+        )
+        _observe(algo, X, y)
+        return algo
+
+    # --- bit-match leg ----------------------------------------------------
+    q0 = qs[0]
+    plan = fresh_algo(True).fused_step_plan(q0)
+    rows_sharded, state_sharded = run_fused_plan(plan)
+    single = FusedPlan(
+        plan.signature, plan.arrays, dict(plan.statics, mesh=None), plan.num
+    )
+    rows_single, state_single = run_fused_plan(single)
+    bit_match = (
+        np.array_equal(np.asarray(rows_sharded), np.asarray(rows_single))
+        and np.array_equal(
+            np.asarray(state_sharded.alpha), np.asarray(state_single.alpha)
+        )
+        and np.array_equal(
+            np.asarray(state_sharded.health), np.asarray(state_single.health)
+        )
+    )
+
+    # --- placement leg ----------------------------------------------------
+    mesh = plan.statics["mesh"]
+    pool = shard_candidates(
+        np.zeros((n_candidates, d), dtype=np.float32), mesh
+    )
+    fractions = placement_fractions(pool)
+    placement = {str(dev): round(frac, 4) for dev, frac in sorted(fractions.items())}
+    devices_holding = sum(1 for frac in fractions.values() if frac > 0)
+
+    # --- q-scaling curve --------------------------------------------------
+    def rounds_per_sec(algo, q, reps):
+        algo._suggest_cube(q)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            np.asarray(algo._suggest_cube(q))
+        return reps * q / (time.perf_counter() - t0)
+
+    reps = 2 if smoke else 3
+    sharded_algo, single_algo = fresh_algo(True), fresh_algo(False)
+    q_curve = []
+    for q in qs:
+        sps_sharded = rounds_per_sec(sharded_algo, q, reps)
+        sps_single = rounds_per_sec(single_algo, q, reps)
+        q_curve.append({
+            "q": q,
+            "sharded_sps": round(sps_sharded, 1),
+            "single_sps": round(sps_single, 1),
+            "ratio": round(sps_sharded / sps_single, 3),
+        })
+    try:
+        host_parallelism = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover - non-Linux host
+        host_parallelism = os.cpu_count() or 1
+    return {
+        "metric": f"sharded suggest over {n_dev} devices"
+                  + (" (SMOKE)" if smoke else ""),
+        "devices": n_dev,
+        "platform": jax.devices()[0].platform,
+        # True only when every mesh device maps to its own core/chip —
+        # the precondition for the ratio to mean anything as a speedup.
+        "parallel_capacity": (
+            jax.devices()[0].platform != "cpu" or host_parallelism >= n_dev
+        ),
+        "bit_match": bit_match,
+        "placement": placement,
+        "devices_holding_shards": devices_holding,
+        "q_curve": q_curve,
+        "smoke": smoke,
+    }
+
+
+def main_sharded(smoke=False):
+    """``bench.py --sharded``: run :func:`bench_sharded` on this process's
+    backend when it is already multi-device; otherwise re-exec into a
+    child with the 8-way virtual CPU mesh (``XLA_FLAGS`` must be set
+    before the backend initializes, which in THIS process it already
+    has)."""
+    import jax
+
+    if jax.device_count() > 1:
+        payload = bench_sharded(smoke=smoke)
+        if smoke:
+            _assert_sharded_smoke(payload)
+        print(json.dumps(payload))
+        return
+    payload = _sharded_subprocess(smoke=smoke)
+    print(json.dumps(payload))
+
+
+def _sharded_subprocess(smoke, n_devices=8, timeout=900.0):
+    """Run ``bench.py --sharded`` in a child process under the virtual
+    CPU mesh and return its parsed payload.  Used by ``main_sharded`` on
+    single-device hosts and by the ``--smoke`` sharded leg (hard-asserts
+    are applied in the CHILD, where the arrays live)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    cmd = [sys.executable, os.path.abspath(__file__), "--sharded"]
+    if smoke:
+        cmd.append("--smoke")
+    proc = subprocess.run(
+        cmd, env=env, capture_output=True, text=True, timeout=timeout
+    )
+    if proc.returncode != 0:
+        raise SystemExit(
+            "sharded leg failed in the virtual-mesh child:\n"
+            + proc.stdout[-2000:] + proc.stderr[-4000:]
+        )
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def _assert_sharded_smoke(payload):
+    """The --smoke sharded leg's hard gate: bit-match or fail, and every
+    virtual device holding a nonzero candidate shard.  SystemExit, not
+    assert: the gate must hold under ``python -O`` too."""
+    if not payload.get("bit_match"):
+        raise SystemExit(
+            "sharded smoke: sharded round does NOT bit-match single-device "
+            f"— {payload}"
+        )
+    if payload.get("devices_holding_shards") != payload.get("devices"):
+        raise SystemExit(
+            "sharded smoke: candidate pool not spread over every device "
+            f"— {payload.get('placement')}"
+        )
+
+
 def lint_preflight():
     """Self-lint the tree before timing anything: bench numbers taken on a
     contract-violating tree (a host sync inside the fused step, a storage
@@ -1548,6 +1749,13 @@ def main_smoke(trace_out="bench_trace.json"):
     payload["rebalance_soak"] = rebalance_block
     payload["doctor"] = doctor_report.summary()
     payload["doctor_critical"] = doctor_report.count("critical")
+    # Sharded leg (ISSUE 16): the multichip suggest path under the 8-way
+    # virtual CPU mesh, in a CHILD process (XLA_FLAGS must precede backend
+    # init).  The child hard-asserts bit-match vs single-device and a
+    # nonzero candidate shard on EVERY virtual device before printing its
+    # payload; re-check both here so a child drift fails THIS gate too.
+    payload["sharded"] = _sharded_subprocess(smoke=True)
+    _assert_sharded_smoke(payload["sharded"])
     # Hard wall-=-device gate (ISSUE 13): smoke fails loudly on host-tax
     # regressions instead of warning into a log nobody reads.
     _check_host_budget(payload, hard=True)
@@ -1577,5 +1785,7 @@ if __name__ == "__main__":
         main_soak(n_workers=workers)
     elif "--serve" in argv:
         main_serve(smoke="--smoke" in argv)
+    elif "--sharded" in argv:
+        main_sharded(smoke="--smoke" in argv)
     else:
         main(smoke="--smoke" in argv, trace_out=out)

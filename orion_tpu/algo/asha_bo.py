@@ -35,6 +35,7 @@ from orion_tpu.algo.tpu_bo import (
     run_suggest_step_arrays,
     tr_update_batch,
 )
+from orion_tpu.algo.sharding import mesh_health_fields
 from orion_tpu.parallel import device_mesh
 
 log = logging.getLogger(__name__)
@@ -376,6 +377,9 @@ class ASHABO(ASHA):
         if self._host.count:
             record["best_y"] = float(self._host.best_y)
             record["n_obs"] = int(self._host.count)
+        if self._mesh is not None:
+            sample = () if self._gp_state is None else (self._gp_state.chol,)
+            record.update(mesh_health_fields(self._mesh, *sample))
         state = self._gp_state
         if state is not None and state.health is not None:
             record.update(unpack_device_health(state.health))

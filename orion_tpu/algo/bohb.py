@@ -160,6 +160,10 @@ class BOHB(Hyperband):
         None while still random-sampling), and the incumbent over every
         tier."""
         record = super().health_record()
+        if self._mesh is not None:
+            from orion_tpu.algo.sharding import mesh_health_fields
+
+            record.update(mesh_health_fields(self._mesh))
         tier = self._model_tier()
         record["model_tier"] = int(tier) if tier is not None else None
         record["tier_counts"] = {

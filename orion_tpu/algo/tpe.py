@@ -17,6 +17,7 @@ import numpy as np
 
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
 from orion_tpu.algo.sampling import clamp_objectives, reflect_unit
+from orion_tpu.algo.sharding import mesh_health_fields
 from orion_tpu.parallel import device_mesh
 
 
@@ -61,6 +62,14 @@ class TPE(BaseAlgorithm):
             mesh=self._mesh,
             bw_factor=self.bw_factor,
         )
+
+    def health_record(self):
+        record = super().health_record()
+        if self._mesh is not None:
+            # serve_width-style placement field (BOHB inherits this, so the
+            # mesh-mode KDE path reports its device count like the GP algos).
+            record.update(mesh_health_fields(self._mesh))
+        return record
 
     def state_dict(self):
         out = super().state_dict()

@@ -1,4 +1,4 @@
-"""JIT/retrace hygiene rules (``JIT001``–``JIT003``).
+"""JIT/retrace hygiene rules (``JIT001``–``JIT004``).
 
 The fused suggest step's zero-retrace contract (PR 4) dies quietly: one
 ``.item()`` inside a jitted function turns every round into a blocking
@@ -490,4 +490,73 @@ class UnpinnedScalarArg(Rule):
                 )
 
 
-JIT_RULES = (HostSyncInJit, BranchOnTraced, UnpinnedScalarArg)
+#: Per-round dispatch surfaces (the fused suggest prep/dispatch chain and
+#: the gateway's coalesced twin) that must not construct sharding objects
+#: per call even though they are not jit-compiled themselves: ``Mesh(...)``
+#: re-hashes the device list and ``NamedSharding(...)`` re-derives the
+#: per-device layout on every call, and — worse — a fresh Mesh object is a
+#: fresh jit-cache static, so a per-call construction silently retraces
+#: what the prewarmer pinned.  Everything here must go through the cached
+#: helpers in ``orion_tpu.algo.sharding`` (``get_mesh``/``candidate_spec``/
+#: ``replicated_spec``), which return the SAME object per signature.
+HOT_PATH_REGISTRY = frozenset({
+    "_suggest_step",
+    "_stacked_suggest_step",
+    "_tenant_parallel_suggest_step",
+    "make_fused_plan",
+    "run_fused_plan",
+    "run_suggest_step_arrays",
+    "stack_plans",
+    "run_coalesced_plans",
+})
+
+#: Sharding-object constructors whose call cost (and jit-static identity)
+#: the rule polices.  Matched on the last dotted component, so ``Mesh``,
+#: ``jax.sharding.Mesh`` and ``sharding.NamedSharding`` all count.
+_SHARDING_CONSTRUCTORS = frozenset({"Mesh", "NamedSharding"})
+
+
+class ShardingConstructionInHotPath(Rule):
+    id = "JIT004"
+    name = "sharding-construction-in-hot-path"
+    description = (
+        "No per-call Mesh(...)/NamedSharding(...) construction inside a "
+        "jit-compiled function or a declared hot-path function "
+        "(HOT_PATH_REGISTRY): a fresh Mesh is a fresh jit-cache static "
+        "(silent retrace) and the construction re-hashes the device list "
+        "every round; use the cached orion_tpu.algo.sharding helpers "
+        "(get_mesh/candidate_spec/replicated_spec)."
+    )
+
+    def check(self, module):
+        jit_nodes = {
+            id(fn.node) for fn in collect_jit_functions(module).values()
+        }
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if id(node) not in jit_nodes and node.name not in HOT_PATH_REGISTRY:
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fname = dotted_name(call.func)
+                if fname is None:
+                    continue
+                if fname.rsplit(".", 1)[-1] not in _SHARDING_CONSTRUCTORS:
+                    continue
+                yield Diagnostic(
+                    module.path,
+                    call.lineno,
+                    call.col_offset,
+                    self.id,
+                    f"{fname}(...) constructed inside hot-path function "
+                    f"'{node.name}' — a per-call sharding object re-hashes "
+                    "the device list and forks the jit-cache statics; use "
+                    "the cached orion_tpu.algo.sharding helpers "
+                    "(get_mesh/candidate_spec/replicated_spec)",
+                )
+
+
+JIT_RULES = (HostSyncInJit, BranchOnTraced, UnpinnedScalarArg,
+             ShardingConstructionInHotPath)

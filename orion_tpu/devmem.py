@@ -11,6 +11,11 @@ out of ``/metrics`` like every other gauge):
   backend allocator's own accounting via ``Device.memory_stats()``, with
   graceful degradation: backends without the API (or returning None —
   older CPU backends) simply publish nothing;
+- ``memory.device_live_bytes.d<id>`` — the same live-array walk split per
+  device via shard metadata (``addressable_shards``), so a sharded run's
+  placement skew is visible in the SAME channel the totals already use
+  (one device holding everything = the silent-sharding-regression signal
+  doctor rule DX006 watches in health records);
 - ``memory.history_device_bytes.b<cap>`` — resident observation-history
   bytes per pow-2 capacity bucket (``DeviceHistory`` introspection: the
   distribution says which experiments are about to cross a bucket);
@@ -70,6 +75,18 @@ def _bucket_gauge_name(cap):
     return name
 
 
+#: Device id -> gauge name, same lazy-name discipline (TEL001).
+_DEVICE_GAUGE_NAMES = {}
+
+
+def _device_gauge_name(dev):
+    name = _DEVICE_GAUGE_NAMES.get(dev)
+    if name is None:
+        name = f"memory.device_live_bytes.d{int(dev)}"
+        _DEVICE_GAUGE_NAMES[dev] = name
+    return name
+
+
 def sample_memory(force=False):
     """Publish the memory/compile gauges; rate-limited to
     :data:`SAMPLE_INTERVAL` unless ``force``.  Returns True when a sample
@@ -103,14 +120,34 @@ def _sample_live_arrays():
         return
     total = 0
     count = 0
+    per_device = {}
     for array in arrays:
         count += 1
         try:
             total += int(array.nbytes)
         except Exception:  # pragma: no cover - deleted buffer mid-walk
             pass
+        # Per-device split off the shard metadata (no transfers) — same
+        # walk, graceful degradation on leaves without the accessor.
+        try:
+            for shard in array.addressable_shards:
+                nbytes = getattr(shard.data, "nbytes", 0)
+                per_device[shard.device.id] = (
+                    per_device.get(shard.device.id, 0) + int(nbytes)
+                )
+        except Exception:  # pragma: no cover - deleted buffer mid-walk
+            pass
     TELEMETRY.set_gauge("memory.device_live_bytes", total)
     TELEMETRY.set_gauge("memory.device_live_arrays", count)
+    # Same zero-stale discipline as the history buckets: a device that held
+    # bytes once but holds none now must publish 0, not its last value.
+    for dev in _DEVICE_GAUGE_NAMES:
+        if dev not in per_device:
+            name = _device_gauge_name(dev)
+            TELEMETRY.set_gauge(name, 0)
+    for dev, nbytes in per_device.items():
+        name = _device_gauge_name(dev)
+        TELEMETRY.set_gauge(name, nbytes)
 
 
 def _sample_backend_stats():

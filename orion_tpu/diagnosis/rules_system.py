@@ -187,10 +187,52 @@ class ServeQueueSaturation(DoctorRule):
             )
 
 
+class MeshUtilizationSkew(DoctorRule):
+    id = "DX006"
+    name = "mesh-utilization-skew"
+    severity = "warn"
+    runbook = "dx006-mesh-utilization-skew"
+    description = (
+        "a sharded (use_mesh) run whose per-device byte placement is "
+        "lopsided: one device holds far more than its even share of the "
+        "sharded buffers — candidate sharding has silently regressed "
+        "toward single-device execution and the other chips idle."
+    )
+
+    #: Worst device's fraction vs the even 1/n share.  Replicated leaves
+    #: (GP state) contribute equally everywhere, so a healthy sharded
+    #: round sits AT the even share; 2x means at least half the sharded
+    #: bytes collapsed onto one device.
+    SKEW_FACTOR = 2.0
+
+    def evaluate(self, snapshot):
+        latest = snapshot.latest_health() or {}
+        # Algo-level fields first (the producer's own fused round), then
+        # the gateway's serve_-prefixed twins (coalesced dispatch).
+        for prefix in ("", "serve_"):
+            devices = latest.get(prefix + "mesh_devices")
+            max_frac = latest.get(prefix + "mesh_util_max_frac")
+            if not devices or max_frac is None or int(devices) < 2:
+                continue
+            even = 1.0 / int(devices)
+            if float(max_frac) >= self.SKEW_FACTOR * even:
+                plane = "gateway" if prefix else "producer"
+                yield self.finding(
+                    f"{plane} mesh placement skew: worst device holds "
+                    f"{float(max_frac):.0%} of sharded bytes vs the even "
+                    f"{even:.0%} share over {int(devices)} devices — "
+                    "candidate sharding is collapsing onto one chip (check "
+                    "pool divisibility and bench --sharded placement)",
+                    value=float(max_frac),
+                    subject=plane,
+                )
+
+
 SYSTEM_RULES = (
     RetraceStorm,
     HeartbeatLag,
     StaleWorker,
     HostBudgetBreach,
     ServeQueueSaturation,
+    MeshUtilizationSkew,
 )

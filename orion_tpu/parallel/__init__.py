@@ -12,34 +12,47 @@ no hand-written pmap plumbing needed.
 """
 
 import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-CANDIDATE_AXIS = "candidates"
+from orion_tpu.algo.sharding import (
+    CANDIDATE_AXIS,
+    TENANT_AXIS,
+    candidate_spec,
+    get_mesh,
+    get_stacked_mesh,
+    replicated_spec,
+    shard_candidates,
+)
+
+__all__ = [
+    "CANDIDATE_AXIS",
+    "TENANT_AXIS",
+    "device_mesh",
+    "candidate_sharding",
+    "replicated",
+    "shard_candidates",
+    "get_stacked_mesh",
+    "init_distributed",
+]
 
 
 def device_mesh(n_devices=None, axis_name=CANDIDATE_AXIS):
-    """1-D mesh over available devices (candidate/data parallel)."""
-    devices = jax.devices()
-    if n_devices is not None:
-        devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (axis_name,))
+    """1-D mesh over available devices (candidate/data parallel).
+
+    Cached: repeated calls with the same topology return the SAME mesh
+    object (`orion_tpu.algo.sharding.get_mesh`), so the fused step's
+    static-arg cache probe is an identity hit and per-call construction
+    never lands on the hot path (lint rule JIT004).
+    """
+    return get_mesh(n_devices, axis_name)
 
 
 def candidate_sharding(mesh, axis_name=CANDIDATE_AXIS):
-    """Shard an (m, d) candidate matrix along m; d replicated."""
-    return NamedSharding(mesh, PartitionSpec(axis_name, None))
+    """Shard an (m, d) candidate matrix along m; d replicated (cached)."""
+    return candidate_spec(mesh, axis_name)
 
 
 def replicated(mesh):
-    return NamedSharding(mesh, PartitionSpec())
-
-
-def shard_candidates(candidates, mesh, axis_name=CANDIDATE_AXIS):
-    """Place host candidates sharded over the mesh (public utility for
-    library users bringing their OWN candidate sets; the built-in engine
-    shards inside its fused jit via `candidate_sharding` instead)."""
-    return jax.device_put(candidates, candidate_sharding(mesh, axis_name))
+    return replicated_spec(mesh)
 
 
 def init_distributed(coordinator=None, num_processes=None, process_id=None,

@@ -29,8 +29,16 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec
 
 from orion_tpu.algo.history import _next_pow2
+from orion_tpu.algo.sharding import (
+    TENANT_AXIS,
+    get_mesh,
+    mesh_utilization,
+    tenant_spec,
+)
 from orion_tpu.algo.tpu_bo import _suggest_step
 
 #: Static-arg names of the stacked step — exactly ``_suggest_step``'s, so a
@@ -62,6 +70,41 @@ def _stacked_suggest_step(stacked, **statics):
     return jax.lax.map(lambda args: _suggest_step(*args, **statics), stacked)
 
 
+@partial(jax.jit, static_argnames=_STACK_STATICS + ("tenant_mesh",))
+def _tenant_parallel_suggest_step(stacked, *, tenant_mesh, **statics):
+    """The stacked step with the TENANT axis as a mesh axis: ``shard_map``
+    partitions the lanes over the devices, so one coalesced dispatch runs
+    T/n lanes PER CHIP concurrently instead of scanning T lanes on one.
+
+    Each device's local computation is ``lax.map`` over its own lanes with
+    the exact standalone per-lane graph — the solve-only fit and the
+    candidate scoring are the graph class the parity pins prove bit-stable
+    across module variants, so the bit-identity contract holds here too
+    (pinned by the sharded legs of ``tests/unit/test_sharded_parity.py``).
+    ``statics['mesh']`` is None inside: a lane's candidate axis cannot also
+    shard once its lane owns a single device, and XLA rejects nested
+    sharding constraints under a manual (shard_map) subgroup.
+    """
+
+    def per_shard(shard):
+        return jax.lax.map(lambda args: _suggest_step(*args, **statics), shard)
+
+    return shard_map(
+        per_shard,
+        mesh=tenant_mesh,
+        in_specs=PartitionSpec(TENANT_AXIS),
+        out_specs=PartitionSpec(TENANT_AXIS),
+        check_rep=False,
+    )(stacked)
+
+
+#: Placement of the most recent mesh-mode coalesced dispatch (metadata-only
+#: reads — no transfers): the gateway's health records and the sharded
+#: bench read these to surface per-device utilization (doctor rule DX006
+#: fires when one device silently ends up doing all the work).
+LAST_STACK_PLACEMENT = {}
+
+
 def stack_plans(plans, t_pad=None):
     """Stack same-signature plans' input arrays along a new leading tenant
     axis, padded to ``t_pad`` (default: the pow-2 bucket of ``len(plans)``)
@@ -72,6 +115,21 @@ def stack_plans(plans, t_pad=None):
     return jax.tree.map(lambda *leaves: jnp.stack(leaves), *lanes)
 
 
+def _tenant_mesh_for(mesh, t_pad):
+    """The tenant-axis mesh a ``t_pad``-wide stack dispatches over, or None
+    when lanes should NOT become a mesh axis.  Only a stack wide enough to
+    give every chip at least one whole lane goes tenant-parallel; a narrow
+    stack keeps the plans' own 1-D candidate mesh (each lane's candidate
+    work sharded over ALL devices, lanes scanned by ``lax.map`` — the
+    bit-stable per-lane module, measured: a 2-D (tenants, candidates)
+    compute mesh re-partitions the per-lane graph and drifts by ulps)."""
+    if mesh is None or mesh.devices.size <= 1:
+        return None
+    if t_pad >= mesh.devices.size:
+        return get_mesh(int(mesh.devices.size), TENANT_AXIS)
+    return None
+
+
 def run_coalesced_plans(plans, t_pad=None):
     """Dispatch same-signature :class:`FusedPlan`s as ONE device call.
 
@@ -79,6 +137,12 @@ def run_coalesced_plans(plans, t_pad=None):
     exactly what :func:`~orion_tpu.algo.tpu_bo.run_fused_plan` would have
     returned for that plan alone (rows sliced to the plan's ``num``, the
     lane's GPState ready for ``consume_fused_step``).
+
+    When the plans carry a multi-device mesh, the stacked tenant axis
+    becomes a SECOND mesh axis: the stacked inputs lay out over it, and
+    with at least one lane per device the lanes themselves execute in
+    parallel via :func:`_tenant_parallel_suggest_step` — same outputs,
+    bit for bit, as serving each tenant alone.
     """
     signature = plans[0].signature
     for plan in plans[1:]:
@@ -86,8 +150,39 @@ def run_coalesced_plans(plans, t_pad=None):
             raise ValueError(
                 "cannot coalesce plans with differing fused-step signatures"
             )
+    t_pad = t_pad or _next_pow2(len(plans), floor=1)
     stacked = stack_plans(plans, t_pad=t_pad)
-    rows, states = _stacked_suggest_step(stacked, **plans[0].statics)
+    mesh = plans[0].statics.get("mesh")
+    tenant_mesh = _tenant_mesh_for(mesh, t_pad)
+    if tenant_mesh is not None:
+        # One lane (or more) per device: lanes run concurrently, each on
+        # its own chip with the single-device per-lane graph.
+        stacked = jax.device_put(stacked, tenant_spec(tenant_mesh))
+        lo, hi = mesh_utilization(tenant_mesh, *stacked[:4])
+        LAST_STACK_PLACEMENT.update(
+            devices=int(tenant_mesh.devices.size),
+            t_pad=int(t_pad),
+            tenant_parallel=True,
+            util_min_frac=lo,
+            util_max_frac=hi,
+        )
+        statics = dict(plans[0].statics, mesh=None)
+        rows, states = _tenant_parallel_suggest_step(
+            stacked, tenant_mesh=tenant_mesh, **statics
+        )
+    else:
+        # No mesh, or a stack too narrow to give every chip a lane: the
+        # scanned stacked step — with a mesh, each lane still shards its
+        # candidate axis over ALL devices via the in-step constraints.
+        if mesh is not None and mesh.devices.size > 1:
+            LAST_STACK_PLACEMENT.update(
+                devices=int(mesh.devices.size),
+                t_pad=int(t_pad),
+                tenant_parallel=False,
+            )
+            LAST_STACK_PLACEMENT.pop("util_min_frac", None)
+            LAST_STACK_PLACEMENT.pop("util_max_frac", None)
+        rows, states = _stacked_suggest_step(stacked, **plans[0].statics)
     out = []
     for lane, plan in enumerate(plans):
         lane_state = jax.tree.map(lambda leaf, lane=lane: leaf[lane], states)
@@ -101,14 +196,23 @@ def prewarm_stacked(sample_plan, t_pad):
     :class:`~orion_tpu.algo.prewarm.BucketPrewarmer` keyed by
     ``("stacked", t_pad) + sample_plan.signature`` so a growing coalesce
     width crosses its pow-2 bucket on a jit-cache hit, never a synchronous
-    stall in the middle of a dispatch cycle."""
+    stall in the middle of a dispatch cycle.  Mirrors the dispatch-mode
+    choice in :func:`run_coalesced_plans` so it warms the entry the real
+    dispatch will hit."""
     dummies = jax.tree.map(
         lambda leaf: jnp.zeros((t_pad,) + leaf.shape, leaf.dtype),
         sample_plan.arrays,
     )
     statics = dict(sample_plan.statics)
+    tenant_mesh = _tenant_mesh_for(statics.get("mesh"), t_pad)
 
     def compile_fn():
-        _stacked_suggest_step(dummies, **statics)
+        if tenant_mesh is None:
+            _stacked_suggest_step(dummies, **statics)
+        else:
+            placed = jax.device_put(dummies, tenant_spec(tenant_mesh))
+            _tenant_parallel_suggest_step(
+                placed, tenant_mesh=tenant_mesh, **dict(statics, mesh=None)
+            )
 
     return compile_fn

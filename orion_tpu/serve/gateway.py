@@ -54,7 +54,11 @@ from orion_tpu.algo.history import _next_pow2
 from orion_tpu.algo.prewarm import BucketPrewarmer
 from orion_tpu.algo.tpu_bo import run_fused_plan
 from orion_tpu.health import FLIGHT
-from orion_tpu.serve.coalesce import prewarm_stacked, run_coalesced_plans
+from orion_tpu.serve.coalesce import (
+    LAST_STACK_PLACEMENT,
+    prewarm_stacked,
+    run_coalesced_plans,
+)
 from orion_tpu.serve.protocol import (
     GATEWAY_OPS,
     GatewayError,
@@ -981,6 +985,18 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         health["serve_width"] = job.width
         health["serve_queue_depth"] = self._queue.qsize()
         health["serve_tenants"] = len(self._tenants)
+        # Sharded-dispatch placement (serve_width-style: the serve layer's
+        # own view).  Only present after a mesh-mode coalesced dispatch —
+        # single-device serving keeps the record exactly as before.
+        if LAST_STACK_PLACEMENT:
+            health["serve_mesh_devices"] = LAST_STACK_PLACEMENT.get("devices")
+            if "util_min_frac" in LAST_STACK_PLACEMENT:
+                health["serve_mesh_util_min_frac"] = LAST_STACK_PLACEMENT[
+                    "util_min_frac"
+                ]
+                health["serve_mesh_util_max_frac"] = LAST_STACK_PLACEMENT[
+                    "util_max_frac"
+                ]
         return health
 
     # --- stats ----------------------------------------------------------------

@@ -79,3 +79,36 @@ def host_caller_good(x):
 def jit_caller_good(x):
     # jit-to-jit: the literal is constant-folded into the trace.
     return scaled(x, 4, 0.5)
+
+
+@jax.jit
+def bad_mesh_in_jit(x):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(jax.devices()), ("candidates",))  # expect: JIT004
+    spec = NamedSharding(mesh, PartitionSpec("candidates"))  # expect: JIT004
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def run_fused_plan(plan):
+    # Declared hot path (HOT_PATH_REGISTRY): not jitted itself, but a
+    # per-call Mesh is a fresh jit-cache static -> silent retrace.
+    import jax.sharding
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("x",))  # expect: JIT004
+    return plan, mesh
+
+
+def good_cold_path_mesh():
+    # Cache-miss builders OUTSIDE the hot set construct freely — this is
+    # where the one-Mesh-per-signature object comes from.
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()), ("candidates",))
+
+
+@jax.jit
+def good_helper_in_jit(x):
+    from orion_tpu.algo.sharding import candidate_spec, get_mesh
+
+    return jax.lax.with_sharding_constraint(x, candidate_spec(get_mesh(8)))

@@ -25,6 +25,7 @@ BREAKDOWN_KEYS = (
     "doc_build",
     "storage_ms",
     "telemetry_us_saved",
+    "prep_us_saved",
 )
 
 #: Spans every bench trace must carry: the produce round, its batched
@@ -95,12 +96,17 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     # Steady-state host tax, trackable across BENCH_* separately from
     # throughput: the sum of the host stages (everything except
     # wait_transfer, the separately-tracked storage_ms, and the
-    # telemetry_us_saved savings report).
+    # telemetry_us_saved / prep_us_saved savings reports).
     assert payload["host_ms_per_round"] == round(
         sum(v for k, v in breakdown.items()
-            if k not in ("wait_transfer", "storage_ms", "telemetry_us_saved")),
+            if k not in ("wait_transfer", "storage_ms", "telemetry_us_saved",
+                         "prep_us_saved")),
         3,
     )
+    # The plan-prep cache (ISSUE 16 satellite): after the first round every
+    # fused-plan build must be a cache hit, and the breakdown reports the
+    # saved host microseconds like telemetry_us_saved.
+    assert breakdown["prep_us_saved"] >= 0
     # The wall-=-device gate (ISSUE 13): bench.py --smoke hard-fails
     # (SystemExit) when the steady-state host tax exceeds 2x device time;
     # this pins the payload relationship on top, with the smoke device
@@ -113,7 +119,7 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     # hard-asserts the same bar before emitting).
     round_ms = sum(
         v for k, v in breakdown.items()
-        if k not in ("storage_ms", "telemetry_us_saved")
+        if k not in ("storage_ms", "telemetry_us_saved", "prep_us_saved")
     )
     assert breakdown["health"] <= 0.01 * round_ms
     # The optimization-health payload: a real per-round regret curve with
@@ -176,6 +182,22 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     assert serve["per_tenant"] and all(
         row["p99_ms"] > 0 for row in serve["per_tenant"].values()
     )
+    # The sharded leg (ISSUE 16): run in a child under the 8-way virtual
+    # CPU mesh, bit-match and full per-device placement hard-asserted by
+    # bench.py (child AND parent); this pins the payload schema on top.
+    sharded = payload["sharded"]
+    assert sharded["devices"] == 8
+    assert sharded["bit_match"] is True
+    assert sharded["devices_holding_shards"] == 8
+    assert len(sharded["placement"]) == 8
+    assert all(frac > 0 for frac in sharded["placement"].values())
+    assert sharded["q_curve"] and all(
+        row["sharded_sps"] > 0 and row["single_sps"] > 0 and row["ratio"] > 0
+        for row in sharded["q_curve"]
+    )
+    # parallel_capacity says whether the throughput ratio means a speedup
+    # on this host (one core timesharing 8 virtual devices: it does not).
+    assert isinstance(sharded["parallel_capacity"], bool)
     for backend in ("sqlite", "network"):
         assert payload["storage_ms"][backend] > 0
         # The batched write path commits a whole q-round as ONE transaction

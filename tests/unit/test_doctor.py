@@ -150,6 +150,27 @@ FIXTURES = {
             now=NOW,
         ),
     ),
+    "DX006": (
+        # 8-device mesh with 60% of sharded bytes on one chip (even share
+        # 12.5%) — the silent-sharding-regression pathology.
+        Snapshot(
+            health=_health(
+                3, mesh_devices=8, mesh_util_min_frac=0.01,
+                mesh_util_max_frac=0.60,
+            ),
+            now=NOW,
+        ),
+        # Healthy sharded round: every device AT the even share (and the
+        # gateway's serve_ twins likewise).
+        Snapshot(
+            health=_health(
+                3, mesh_devices=8, mesh_util_min_frac=0.125,
+                mesh_util_max_frac=0.125, serve_mesh_devices=8,
+                serve_mesh_util_min_frac=0.125, serve_mesh_util_max_frac=0.125,
+            ),
+            now=NOW,
+        ),
+    ),
     "DX020": (
         Snapshot(
             metrics=_metrics(
