@@ -21,8 +21,10 @@ Prints ONE json line:
 the per-stage host/device split of one steady-state round (encode, upload,
 dispatch, wait_transfer, decode, dict_build, doc_build; see
 bench_breakdown).  The steady-state host tax is gated against device time
-(_check_host_budget: 2x factor, ORION_TPU_HOST_BUDGET_FACTOR overrides —
-hard SystemExit in --smoke, warning on full runs).
+(_check_host_budget: 1.25x factor from orion_tpu.hostbudget — the same
+knob the doctor's DX004 rule and `orion-tpu top` read;
+ORION_TPU_HOST_BUDGET_FACTOR overrides — hard SystemExit in --smoke,
+warning on full runs).
 """
 
 import json
@@ -366,13 +368,21 @@ def bench_breakdown(rounds=4, q=Q, algo=None, n_hist=130):
     _observe(algo, X, _hartmann6_np(X))
     algo.suggest(q)  # compile
 
-    from orion_tpu.algo.tpu_bo import plan_prep_stats, reset_plan_prep_stats
+    from orion_tpu.algo.tpu_bo import (
+        dispatch_prep_stats,
+        plan_prep_stats,
+        reset_dispatch_prep_stats,
+        reset_plan_prep_stats,
+    )
     from orion_tpu.core.trial import TrialBatch
 
     # Plan-prep cache accounting over the measured rounds only: the µs the
     # per-signature cache saves inside the dispatch stage (statics dict +
-    # signature + cold-hypers rebuilt on a miss, reused on a hit).
+    # signature + cold-hypers rebuilt on a miss, reused on a hit), and the
+    # µs the per-instance prep token saves on top (skipping the prep-key
+    # probe entirely on the steady path).
     reset_plan_prep_stats()
+    reset_dispatch_prep_stats()
 
     stages = {k: [] for k in
               ("encode", "upload", "dispatch", "wait_transfer", "health",
@@ -405,10 +415,11 @@ def bench_breakdown(rounds=4, q=Q, algo=None, n_hist=130):
                                     t6 - t5, t7 - t6)):
             stages[key].append(dt)
     out = {k: round(1e3 * float(np.median(v)), 3) for k, v in stages.items()}
-    # SAVINGS report like telemetry_us_saved, not a stage: the dispatch
+    # SAVINGS reports like telemetry_us_saved, not stages: the dispatch
     # medians above already CONTAIN the cache-hit prep, so the saved µs must
     # be excluded from every host_ms sum (test_bench_smoke pins this).
     out["prep_us_saved"] = plan_prep_stats()["saved_us"]
+    out["dispatch_us_saved"] = dispatch_prep_stats()["saved_us"]
     return out
 
 
@@ -436,6 +447,45 @@ def bench_telemetry_batching(samples_per_round=4, rounds=400):
         tel.record_spans_batch(entries)
     batched = _time.perf_counter() - t0
     return round((per_call - batched) / rounds * 1e6, 2)
+
+
+def bench_id_hash(q=1024, reps=5):
+    """Trial-identity cost at the bench batch size: the md5 path
+    (per-trial repr assembly + md5, ``compute_batch_ids``) vs the
+    ``cube_hash`` scheme (ONE vectorized pass over the canonical cube-row
+    bytes, ``compute_scheme_ids``) — the ~6.4µs/trial repr+md5 floor was
+    the last per-trial host line of the registration tail (ROADMAP item
+    5).  Returns per-trial µs for both paths, the speedup, and a
+    ``distinct_ok`` collision check over the q-batch; ``--smoke``
+    hard-gates ``speedup >= 4`` at q=1024."""
+    from orion_tpu.core.trial import compute_batch_ids, compute_scheme_ids
+    from orion_tpu.space.dsl import build_space
+
+    space = build_space({f"x{i}": "uniform(0, 1)" for i in range(6)})
+    rng = np.random.default_rng(SEED + 3)
+    cube = rng.uniform(size=(q, 6)).astype(np.float32)
+    arrays = space.decode_flat_np(cube)
+    params = space.arrays_to_params(arrays)
+    exp_id = "bench-id-hash"
+    md5_times, cube_times = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        md5_ids = compute_batch_ids(exp_id, params)
+        md5_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cube_ids = compute_scheme_ids(
+            exp_id, params, id_scheme="cube_hash", space=space
+        )
+        cube_times.append(time.perf_counter() - t0)
+    md5_us = float(np.median(md5_times)) / q * 1e6
+    cube_us = float(np.median(cube_times)) / q * 1e6
+    return {
+        "q": q,
+        "md5_us_per_trial": round(md5_us, 3),
+        "cube_hash_us_per_trial": round(cube_us, 3),
+        "speedup": round(md5_us / cube_us, 2) if cube_us else None,
+        "distinct_ok": len(set(cube_ids)) == q and len(set(md5_ids)) == q,
+    }
 
 
 def bench_prewarm(q=16):
@@ -483,7 +533,8 @@ def bench_prewarm(q=16):
 
 
 def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
-                fit_steps=4, storage=None):
+                fit_steps=4, storage=None, algorithms=None, priors=None,
+                name_prefix="bench-serve"):
     """The multi-tenant suggest gateway, full stack (orion_tpu.serve):
     M concurrent experiments — each a REAL producer/worker loop over one
     shared sqlite store, its algorithm a gateway-backed RemoteAlgorithm —
@@ -501,7 +552,13 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
 
     Returns the ``serve`` payload block: coalesce width stats, device
     dispatches per suggest, per-tenant request p50/p99 (from the gateway's
-    per-tenant telemetry histograms), backpressure/eviction counts."""
+    per-tenant telemetry histograms), backpressure/eviction counts.
+
+    ``algorithms``/``priors`` parametrize the tenants' experiments (default:
+    6-dim Hartmann6 under tpu_bo) — the ``--serve --smoke`` asha_bo leg
+    reuses this same harness with a fidelity dimension added; the objective
+    is always Hartmann6 over the ``x*`` parameters, so a fidelity column
+    simply rides along unscored."""
     import os
     import tempfile
     import threading
@@ -514,6 +571,19 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
     from orion_tpu.storage.base import create_storage
     from orion_tpu.telemetry import histogram_percentile
 
+    if priors is None:
+        priors = {f"x{j}": "uniform(0, 1)" for j in range(6)}
+    if algorithms is None:
+        algorithms = {
+            "tpu_bo": {
+                "n_init": q,
+                "n_candidates": n_candidates,
+                "fit_steps": fit_steps,
+            }
+        }
+    x_names = sorted(
+        (k for k in priors if k.startswith("x")), key=lambda k: int(k[1:])
+    )
     was_enabled = tel.TELEMETRY.enabled
     tel.TELEMETRY.enable()
     server = GatewayServer(window=window, max_width=max(2, m_tenants))
@@ -531,15 +601,9 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
                 try:
                     experiment = build_experiment(
                         storage,
-                        f"bench-serve-{index}",
-                        priors={f"x{j}": "uniform(0, 1)" for j in range(6)},
-                        algorithms={
-                            "tpu_bo": {
-                                "n_init": q,
-                                "n_candidates": n_candidates,
-                                "fit_steps": fit_steps,
-                            }
-                        },
+                        f"{name_prefix}-{index}",
+                        priors=priors,
+                        algorithms=algorithms,
                         pool_size=q,
                         metadata={"user": "bench"},
                     )
@@ -554,7 +618,7 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
                         trials = client.suggest(q)
                         X = np.asarray(
                             [
-                                [t.params[f"x{j}"] for j in range(6)]
+                                [t.params[name] for name in x_names]
                                 for t in trials
                             ],
                             dtype=np.float32,
@@ -661,6 +725,24 @@ def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
             m_tenants=2, rounds=3, q=8, window=0.4, n_candidates=256,
             fit_steps=4, storage=DocumentStorage(net_db),
         )
+        # asha_bo leg (host-tail endgame): two multi-fidelity tenants whose
+        # fused-step signatures must still line up — promotions are consumed
+        # host-side, only the FRESH points ride the device plan, and the
+        # bucket-normalized shapes (q bucket, quantized local_sigma ladder)
+        # keep both tenants coalescible.  bench_serve hard-asserts
+        # max_width >= 2 inside.
+        serve_asha_block = bench_serve(
+            m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=128,
+            fit_steps=4, storage=DocumentStorage(net_db),
+            algorithms={
+                "asha_bo": {"n_init": 8, "n_candidates": 128, "fit_steps": 4}
+            },
+            priors={
+                **{f"x{j}": "uniform(0, 1)" for j in range(6)},
+                "epochs": "fidelity(1, 9, 3)",
+            },
+            name_prefix="bench-serve-asha",
+        )
         db_server.flush_server_spans(force=True)
         server_spans = DocumentStorage(net_db).fetch_spans(SERVER_EXPERIMENT)
     finally:
@@ -676,6 +758,7 @@ def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
     payload = {
         "metric": "serve gateway smoke (distributed trace)",
         "serve": serve_block,
+        "serve_asha": serve_asha_block,
         "serve_trace_file": trace_path,
         "trace": joined,
     }
@@ -852,7 +935,7 @@ def _json_payload(
         sum(
             v for k, v in breakdown_ms.items()
             if k not in ("wait_transfer", "storage_ms", "telemetry_us_saved",
-                         "prep_us_saved")
+                         "prep_us_saved", "dispatch_us_saved")
             and v is not None
         ),
         3,
@@ -994,7 +1077,8 @@ def _assert_health_overhead(breakdown):
     health_ms = breakdown.get("health")
     round_ms = sum(
         v for k, v in breakdown.items()
-        if k not in ("storage_ms", "telemetry_us_saved", "prep_us_saved")
+        if k not in ("storage_ms", "telemetry_us_saved", "prep_us_saved",
+                     "dispatch_us_saved")
         and v is not None
     )
     assert health_ms is not None and round_ms > 0
@@ -1067,6 +1151,7 @@ def main(smoke=False, trace_out="bench_trace.json"):
     )
     payload["trace_file"] = trace_file
     payload["host_attribution"] = host_attribution
+    payload["id_hash"] = bench_id_hash(q=1024)
     doctor_report = doctor_gate(health_records, hard=False)
     payload["doctor"] = doctor_report.summary()
     payload["doctor_critical"] = doctor_report.count("critical")
@@ -1090,12 +1175,13 @@ def _safe_trace(trace_out):
 
 def _host_budget_factor():
     """The wall≈device bar: host tax may be at most FACTOR x device time
-    (ROADMAP item 2 / ISSUE 13 say 2x).  Env-overridable so an unusual
-    runner (a remote-tunnel TPU with pathological transfer latency) can
-    re-tune without editing the gate."""
-    import os
+    (ROADMAP item 5 tightened the ISSUE-13 2x to 1.25x).  Delegates to
+    ``orion_tpu.hostbudget`` — the SAME knob the doctor's DX004 rule and
+    ``orion-tpu top``'s ratio column read, so the gates cannot drift;
+    ORION_TPU_HOST_BUDGET_FACTOR overrides everywhere at once."""
+    from orion_tpu.hostbudget import host_budget_factor
 
-    return float(os.environ.get("ORION_TPU_HOST_BUDGET_FACTOR", "2.0"))
+    return host_budget_factor()
 
 
 def _check_host_budget(payload, hard=False):
@@ -1106,10 +1192,11 @@ def _check_host_budget(payload, hard=False):
     and the attribution block says where the excess lives).  ``--smoke``
     hard-fails (SystemExit, so the gate holds under ``python -O``): the
     2x target was met by ISSUE 13's vectorized codec + columnar commit,
-    and tier-1 must catch a host-tax regression before the next full
-    bench run does.  In smoke (no device decomposition phase) the device
-    reference is the breakdown's ``wait_transfer`` stage — device
-    execution + result transfer of the same measured round."""
+    and the host-tail endgame (prep token, byte-hash ids) tightened the
+    bar to 1.25x; tier-1 must catch a host-tax regression before the
+    next full bench run does.  In smoke (no device decomposition phase)
+    the device reference is the breakdown's ``wait_transfer`` stage —
+    device execution + result transfer of the same measured round."""
     import sys
 
     factor = _host_budget_factor()
@@ -1121,7 +1208,7 @@ def _check_host_budget(payload, hard=False):
         return
     if host > factor * device:
         message = (
-            f"host_ms_per_round={host} exceeds the ROADMAP item-2 target of "
+            f"host_ms_per_round={host} exceeds the ROADMAP item-5 target of "
             f"{factor}x device time ({device} ms; ORION_TPU_HOST_BUDGET_FACTOR "
             "overrides) — see breakdown_ms and the host_attribution block "
             "for the client-host/wire/server-host/device split"
@@ -1649,6 +1736,16 @@ def main_smoke(trace_out="bench_trace.json"):
     breakdown["storage_ms"] = storage_ms["sqlite"]
     breakdown["telemetry_us_saved"] = bench_telemetry_batching(rounds=50)
     _assert_health_overhead(breakdown)
+    # Trial-identity gate (host-tail endgame): the cube_hash scheme must
+    # beat the per-trial repr+md5 path by >= 4x at the bench batch size,
+    # and stay collision-free over the batch.
+    id_hash = bench_id_hash(q=1024)
+    if not id_hash["distinct_ok"] or id_hash["speedup"] < 4:
+        # Not an assert: the gate must hold under `python -O` too.
+        raise SystemExit(
+            "id-hash gate failed: cube_hash must be >= 4x faster than md5 "
+            f"at q={id_hash['q']} and collision-free — {id_hash}"
+        )
     prewarm = bench_prewarm(q=8)
     assert prewarm["retraces_after_warm"] in (None, 0), (
         f"pow-2 boundary crossing paid {prewarm['retraces_after_warm']} "
@@ -1721,6 +1818,21 @@ def main_smoke(trace_out="bench_trace.json"):
         deadline=120.0,
     )
     trace_file, host_attribution = _safe_trace(trace_out)
+    # Smoke's round decomposition: the breakdown's wait_transfer stage IS
+    # the measured device window (execution + result transfer), and the
+    # wall is the full stage sum — so the appended history record carries
+    # real host/device/storage columns even for smoke runs, keeping the
+    # host/device ratio trendable across the whole series.
+    smoke_device_ms = round(breakdown["wait_transfer"], 3)
+    smoke_wall_ms = round(
+        sum(
+            v for k, v in breakdown.items()
+            if k not in ("storage_ms", "telemetry_us_saved",
+                         "prep_us_saved", "dispatch_us_saved")
+            and v is not None
+        ),
+        3,
+    )
     payload = _json_payload(
         metric=(
             f"SMOKE (q={q}): schema check only — run without "
@@ -1730,8 +1842,8 @@ def main_smoke(trace_out="bench_trace.json"):
         vs_baseline=None,
         regret=None,
         anchor_regret=None,
-        wall_ms_per_round=None,
-        device_ms_per_round=None,
+        wall_ms_per_round=smoke_wall_ms,
+        device_ms_per_round=smoke_device_ms,
         breakdown_ms=breakdown,
         storage_ms=storage_ms,
         storage_ops_per_round=storage_ops,
@@ -1756,9 +1868,24 @@ def main_smoke(trace_out="bench_trace.json"):
     # payload; re-check both here so a child drift fails THIS gate too.
     payload["sharded"] = _sharded_subprocess(smoke=True)
     _assert_sharded_smoke(payload["sharded"])
-    # Hard wall-=-device gate (ISSUE 13): smoke fails loudly on host-tax
-    # regressions instead of warning into a log nobody reads.
+    payload["id_hash"] = id_hash
+    # Hard wall-=-device gate (ISSUE 13, tightened to 1.25x by the
+    # host-tail endgame): smoke fails loudly on host-tax regressions
+    # instead of warning into a log nobody reads.
     _check_host_budget(payload, hard=True)
+    # The cross-run record must carry the round decomposition: a smoke
+    # run that silently dropped host/device/storage columns would leave
+    # the BENCH_history series untrendable for the doctor's rules.
+    record = bench_history_record(payload)
+    missing = [
+        k for k in ("host_ms_per_round", "device_ms_per_round", "storage_ms")
+        if not record.get(k)
+    ]
+    if missing:
+        # Not an assert: the gate must hold under `python -O` too.
+        raise SystemExit(
+            f"bench history record dropped round-decomposition fields: {missing}"
+        )
     print(json.dumps(payload))
     append_bench_history(payload)
 

@@ -279,21 +279,34 @@ class ASHA(BaseAlgorithm):
         return np.asarray(jax.random.uniform(key, (num, self.space.n_cols)))
 
     def _sample_new(self, num):
-        # Softmax over negative bottom-rung occupancy chooses a bracket per
-        # point (reference `asha.py:191-198`), vectorized host-side; the
-        # actual sampling is one batched device draw.
+        # RNG order is part of the bit-stream contract: the bracket-softmax
+        # key is drawn BEFORE `_new_cube`'s sampling key, exactly as the
+        # fused-plan path (`asha_bo.fused_step_plan`) stashes it before
+        # building its plan — both routes consume the stream identically.
+        bracket_key = self.next_key()
+        u = self._new_cube(num)
+        return self._assign_new_points(u, bracket_key)
+
+    def _assign_new_points(self, u, bracket_key):
+        """Decode fresh bottom-rung cube rows into full params: softmax
+        over negative bottom-rung occupancy chooses a bracket per point
+        (reference `asha.py:191-198`, vectorized host-side), the bracket's
+        bottom fidelity is stamped on, and the slot is pre-registered
+        (objective pending) so the point is never re-suggested.  Shared by
+        the host sampling path (`_sample_new`) and the gateway's fused
+        demux (`asha_bo.finish_fused_rows`) — one assignment path, so
+        coalesced and standalone suggests cannot drift."""
+        num = len(u)
         sizes = np.asarray(
             [len(b.rungs[0]["results"]) for b in self.brackets], dtype=np.float64
         )
         logits = -sizes  # fewer points -> more likely
         probs = np.exp(logits - logits.max())
         probs /= probs.sum()
-        bracket_key = self.next_key()
         draws = np.asarray(jax.random.uniform(bracket_key, (num,)))
         bracket_ids = np.minimum(
             np.searchsorted(np.cumsum(probs), draws), len(self.brackets) - 1
         )
-        u = self._new_cube(num)
         arrays = self.space.decode_flat_np(u)
         out = []
         for i, params in enumerate(self.space.arrays_to_params(arrays)):

@@ -31,8 +31,10 @@ from orion_tpu.algo.history import DeviceHistory, HostHistory, _next_pow2
 from orion_tpu.algo.prewarm import DEFAULT_PREWARM_FILL, BucketPrewarmer
 from orion_tpu.algo.sampling import clamp_objectives
 from orion_tpu.algo.tpu_bo import (
+    PlanPrepToken,
+    make_fused_plan,
     maybe_prewarm_fused_step,
-    run_suggest_step_arrays,
+    run_fused_plan,
     tr_update_batch,
 )
 from orion_tpu.algo.sharding import mesh_health_fields
@@ -167,13 +169,31 @@ class ASHABO(ASHA):
         # when stalled.
         self._sigma = local_sigma
         self._best_seen = np.inf
+        # Steady-path dispatch prep, as in TPUBO: the frozen part of
+        # `_step_kw` and the resolved _PlanPrep ride per-instance caches
+        # (the per-round variants — quantized local_sigma, tr_length — are
+        # passed explicitly each round, see `_gp_plan`).
+        self._step_kw_cache = None
+        self._prep_token = PlanPrepToken()
+        # Fused-round carry state (see `fused_step_plan`): promotions the
+        # plan round already consumed (host-scheduled, no device work) and
+        # the bracket-softmax key — drawn AFTER them and BEFORE the plan's
+        # sampling key, preserving `suggest`'s exact RNG order.  Both are
+        # consumed by `finish_fused_rows` after the gateway dispatch.
+        self._pending_promoted = None
+        self._pending_bracket_key = None
 
     # Naive-copy sharing (base __deepcopy__): the fitted GP state
     # (n_pad x n_pad Cholesky), the (uncopyable) mesh handle, and the
     # prewarmer (threads/locks; the jit cache it warms is process-wide).
     # `_hist`/`_host` are NOT shared by ref — their own __deepcopy__ does
-    # copy-on-write of the buffers (see tpu_bo/history).
-    _share_by_ref = ("space", "_gp_state", "_mesh", "_prewarmer")
+    # copy-on-write of the buffers (see tpu_bo/history).  The step-kw
+    # cache (never mutated after build) and prep token (atomic pinned
+    # pair) are shared so naive clones ride the same warm prep.
+    _share_by_ref = (
+        "space", "_gp_state", "_mesh", "_prewarmer",
+        "_step_kw_cache", "_prep_token",
+    )
 
     # Back-compat views over the augmented host history (host consumers
     # and tests read these; appends go through `_host`).
@@ -320,10 +340,12 @@ class ASHABO(ASHA):
             mesh=self._mesh,
         )
 
-    def _new_cube(self, num):
+    def _gp_plan(self, num):
+        """This round's fidelity-augmented GP acquisition as a
+        :class:`~orion_tpu.algo.tpu_bo.FusedPlan` — ONE builder behind the
+        standalone dispatch (`_new_cube`) and the gateway's coalescing
+        path (`fused_step_plan`), so their inputs cannot drift."""
         n = self._host.count
-        if n < self.n_init:
-            return super()._new_cube(num)
         self._last_q_bucket = _next_pow2(num, floor=8)
         if self.trust_region:
             # Global argmin: early TR rounds have almost nothing at the top
@@ -337,7 +359,16 @@ class ASHABO(ASHA):
             best_row = self._top_best_idx
         d = self.space.n_cols
         best_x = self._host.x[best_row, :d]
-        step_kw = self._step_kw()
+        step_kw = self._step_kw_cache
+        if step_kw is None:
+            # The per-round variants (traced tr_length, the quantized
+            # local_sigma static) are passed explicitly below; everything
+            # else is frozen at __init__, so the dict rides the instance
+            # and is never mutated after build.
+            step_kw = dict(self._step_kw())
+            for name in ("tr_length", "local_sigma"):
+                step_kw.pop(name, None)
+            self._step_kw_cache = step_kw
         if self.trust_region and n > self.tr_local_m:
             # Local GP on the nearest observations (x-distance, fidelity
             # ignored): keeps lengthscales local, Cholesky small.  The
@@ -349,16 +380,109 @@ class ASHABO(ASHA):
             )
         else:
             # Full-history fast path: the augmented history already lives
-            # on device, and the (rank-global) copula transform, when
-            # enabled, runs in-jit — nothing history-sized is rebuilt on
-            # host or shipped per round.
+            # on device (pow-2 bucketed buffers — DeviceHistory growth —
+            # so two tenants in the same bucket produce shape-aligned,
+            # hence coalescible, signatures), and the (rank-global) copula
+            # transform, when enabled, runs in-jit — nothing history-sized
+            # is rebuilt on host or shipped per round.
             x_dev, y_dev, mask_dev, _ = self._hist.fit_view()
-        rows, state = run_suggest_step_arrays(
+        return make_fused_plan(
             self.next_key(), x_dev, y_dev, mask_dev, best_x,
-            self._gp_state, num, prewarmer=self._prewarmer, **step_kw,
+            self._gp_state, num,
+            tr_length=self._tr_length,
+            # Quantized to a pow-2 ladder (a STATIC of the fused jit; a
+            # freely-varying value would recompile per round).  The prep
+            # token's fast key revalidates it, so a ladder move is a
+            # correct token miss, not a stale plan.
+            local_sigma=float(2.0 ** round(np.log2(self._sigma))),
+            prep_token=self._prep_token,
+            **step_kw,
         )
+
+    def _new_cube(self, num):
+        n = self._host.count
+        if n < self.n_init:
+            return super()._new_cube(num)
+        plan = self._gp_plan(num)
+        rows, state = run_fused_plan(plan, prewarmer=self._prewarmer)
         self._gp_state = state
         return rows
+
+    # --- serve-gateway coalescing --------------------------------------------
+    def suggest(self, num=1):
+        # A fused round that fell back to the plain path after consuming
+        # its promotions (all-promotion round, or a failed dispatch) must
+        # serve the stash first — `_promote_one` already RESERVED those
+        # next-rung slots, so dropping them would strand the slots pending
+        # forever.  Stream-identical to a standalone round: the stash is
+        # exactly the promotions `suggest` would have emitted first.
+        stash, self._pending_promoted = self._pending_promoted, None
+        self._pending_bracket_key = None
+        if not stash:
+            return super().suggest(num)
+        out = list(stash)
+        while len(out) < num:
+            promoted = self._promote_one()
+            if promoted is None:
+                break
+            out.append(promoted)
+        remaining = num - len(out)
+        if remaining:
+            out.extend(self._sample_new(remaining))
+        return out or None
+
+    def fused_step_plan(self, num):
+        """This round as a coalescible plan, or None when there is nothing
+        to dispatch (random-init phase, or the round is promotions-only).
+        Mirrors ``suggest``'s order exactly: pending promotions are
+        consumed FIRST into a stash (host-scheduled rung pointer-chasing —
+        no device work), then the remaining fresh bottom-rung samples
+        become the fused plan.  Like TPUBO's, the plan is CONSUMING: it
+        advances the RNG stream — the bracket-softmax key is stashed ahead
+        of the plan's sampling key, preserving ``_sample_new``'s draw
+        order — so a holder MUST dispatch it and feed the rows through
+        :meth:`finish_fused_rows`.  A stash left over from a failed
+        dispatch is re-served before anything new is consumed."""
+        if self._host.count < self.n_init:
+            return None
+        promoted = self._pending_promoted
+        if promoted is None:
+            promoted = []
+        while len(promoted) < num:
+            point = self._promote_one()
+            if point is None:
+                break
+            promoted.append(point)
+        self._pending_promoted = promoted
+        remaining = num - len(promoted)
+        if remaining <= 0:
+            # Promotions-only round: no device work — the gateway's plain
+            # path (our `suggest` override) serves the stash.
+            return None
+        self._pending_bracket_key = self.next_key()
+        return self._gp_plan(remaining)
+
+    def consume_fused_step(self, state):
+        """Accept the GPState a fused-plan dispatch produced (warm-start
+        source for the next round's fit + packed device health)."""
+        self._gp_state = state
+
+    def finish_fused_rows(self, rows):
+        """Demux hook for the gateway: turn dispatched cube rows into full
+        params — bracket assignment (stashed softmax key), fidelity stamp,
+        rung pre-registration via the same `_assign_new_points` the host
+        sampling path uses (raw cube rows would bypass all three) — with
+        the round's stashed promotions prepended, exactly where
+        ``suggest`` would have put them."""
+        key, self._pending_bracket_key = self._pending_bracket_key, None
+        promoted, self._pending_promoted = self._pending_promoted, None
+        if key is None:
+            raise RuntimeError(
+                "finish_fused_rows without a pending fused_step_plan"
+            )
+        return list(promoted or ()) + self._assign_new_points(
+            np.asarray(rows), key
+        )
 
     # --- health --------------------------------------------------------------
     def health_record(self):

@@ -312,6 +312,12 @@ class TPUBO(BaseAlgorithm):
         # Fresh-restart override: row index the trust box centers on after a
         # collapse with no progress (None = the global incumbent).
         self._tr_center = None
+        # Steady-path dispatch prep (docs/performance.md, "Attributing the
+        # round"): the statics part of `_step_kw` and the resolved
+        # _PlanPrep ride per-instance caches so a steady round skips the
+        # dict rebuild and the 16-tuple prep-key probe entirely.
+        self._step_kw_cache = None
+        self._prep_token = PlanPrepToken()
 
     # Naive-copy sharing (base __deepcopy__): the mesh handle and the
     # prewarmer's threads/locks are not copyable (and the jit cache they
@@ -320,7 +326,14 @@ class TPUBO(BaseAlgorithm):
     # deliberately NOT here: their own __deepcopy__ implements
     # copy-on-write sharing of the buffers (a plain by-ref share would let
     # the clone's in-place appends clobber the real algorithm's history).
-    _share_by_ref = ("space", "_mesh", "_gp_state", "_prewarmer")
+    # `_step_kw_cache` (never mutated after build) and `_prep_token`
+    # (atomic-by-rebinding pinned pair) ARE shared: a naive clone prepares
+    # the same signatures, so it should ride the same warm caches — and a
+    # deepcopy of either would walk the mesh handle / device scalars.
+    _share_by_ref = (
+        "space", "_mesh", "_gp_state", "_prewarmer",
+        "_step_kw_cache", "_prep_token",
+    )
 
     # Back-compat views of the observation history (tests and host-side
     # consumers read these; appends go through `_host`).
@@ -431,7 +444,15 @@ class TPUBO(BaseAlgorithm):
             else self._host.best_idx  # O(1): tracked incrementally
         )
         best_x = self._host.x[center_idx]
-        step_kw = self._step_kw()
+        step_kw = self._step_kw_cache
+        if step_kw is None:
+            # tr_length is the per-round traced input (passed explicitly
+            # below); every other `_step_kw` entry is frozen at __init__,
+            # so the dict rides the instance and is never mutated after
+            # build (shared by ref with naive clones).
+            step_kw = dict(self._step_kw())
+            step_kw.pop("tr_length", None)
+            self._step_kw_cache = step_kw
         if self.trust_region and n > self.tr_local_m:
             # LOCAL GP (the TuRBO design): fit only the tr_local_m nearest
             # observations to the incumbent.  A global fit has to average
@@ -454,7 +475,8 @@ class TPUBO(BaseAlgorithm):
             x_dev, y_dev, mask_dev, _ = self._hist.fit_view()
         return make_fused_plan(
             self.next_key(), x_dev, y_dev, mask_dev, best_x,
-            self._gp_state, num, **step_kw,
+            self._gp_state, num, tr_length=self._tr_length,
+            prep_token=self._prep_token, **step_kw,
         )
 
     def consume_fused_step(self, state):
@@ -1030,6 +1052,121 @@ def reset_plan_prep_stats():
     _PLAN_PREP_STATS.update(hits=0, misses=0, hit_ns=0, miss_ns=0)
 
 
+#: Distinct trust-region lengths a token's device-scalar cache may hold.
+#: The TuRBO schedule walks a short halving/doubling ladder, so a runaway
+#: set means a caller feeds free-form floats — the cache resets rather
+#: than grow without bound.
+_TR_CACHE_MAX = 64
+
+
+class PlanPrepToken:
+    """Per-algorithm-instance steady-path dispatch-prep cache.
+
+    ``_PLAN_PREP_CACHE`` already skips re-deriving the signature-invariant
+    plan leaves, but *probing* it still costs building the 16-element
+    ``prep_key`` (hashing the mesh handle included) plus the ``_step_kw``
+    statics-dict rebuild, every round.  A token pins the resolved
+    :class:`_PlanPrep` for ONE instance and revalidates only what that
+    instance can change between rounds — the history shape bucket, the q
+    bucket, warm-vs-cold, the quantized ``local_sigma`` ladder
+    (``asha_bo``), and the fit-step knobs; every other static is frozen at
+    ``__init__``.  A caller that mutates a frozen static mid-run must drop
+    the token (``algo._prep_token = PlanPrepToken()``).
+
+    ``pinned`` is the ``(fast_key, prep)`` pair, swapped as ONE tuple:
+    immutable-by-rebinding, so a concurrent reader (gateway dispatch
+    thread vs producer clone sharing the token by ref) can never observe a
+    torn key/prep mix.  ``tr_cache`` re-uses the uploaded device scalar
+    per distinct trust-region length.  Donation safety: ``_suggest_step``
+    declares no ``donate_argnums``, so re-passing the same device buffer
+    (tr scalar, ``default_tr``, ``cold_hypers``) round after round can
+    never alias a donated input — the same COW discipline as
+    ``DeviceHistory._append_donating``, where a buffer handed to a
+    donating jit is never re-entered.
+    """
+
+    __slots__ = ("pinned", "tr_cache")
+
+    def __init__(self):
+        self.pinned = None
+        self.tr_cache = {}
+
+    def __deepcopy__(self, memo):
+        # Fallback for algos that don't share the token by ref: a true
+        # deepcopy would walk device buffers; a clone starting cold only
+        # costs one full prep probe.
+        return type(self)()
+
+
+_DISPATCH_PREP_STATS = {"hits": 0, "misses": 0, "hit_ns": 0, "miss_ns": 0}
+
+
+def dispatch_prep_stats():
+    """Steady-path dispatch-prep effect for the bench breakdown
+    (``dispatch_us_saved``): measured mean prep cost on the token fast
+    path vs the full prep-key probe, and the µs the token saved overall."""
+    hits = _DISPATCH_PREP_STATS["hits"]
+    misses = _DISPATCH_PREP_STATS["misses"]
+    hit_us = _DISPATCH_PREP_STATS["hit_ns"] / 1e3 / hits if hits else 0.0
+    miss_us = _DISPATCH_PREP_STATS["miss_ns"] / 1e3 / misses if misses else 0.0
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_us_mean": hit_us,
+        "miss_us_mean": miss_us,
+        "saved_us": hits * max(0.0, miss_us - hit_us),
+    }
+
+
+def reset_dispatch_prep_stats():
+    _DISPATCH_PREP_STATS.update(hits=0, misses=0, hit_ns=0, miss_ns=0)
+
+
+def _finish_plan(
+    prep,
+    key,
+    x,
+    y,
+    mask,
+    best_x,
+    warm_state,
+    warm_is_none,
+    num,
+    tr_length,
+    tr_cache,
+    kernel,
+    y_transform,
+):
+    """Per-round plan tail shared by the token fast path and the full prep
+    path — ONE implementation, so a token hit is bit-identical by
+    construction to the plan the full path would have built."""
+    warm = prep.cold_hypers if warm_is_none else warm_state.hypers
+    if prep.split_fit:
+        warm = _fit_gp_host(
+            x, y, mask, warm,
+            kernel=kernel,
+            fit_steps=prep.host_fit_steps,
+            y_transform=y_transform,
+        )
+    # tr_length is dynamic (traced) so success/failure box resizing never
+    # recompiles; always an array — jit caches on dtype, not value.  The
+    # token's tr_cache skips the per-round host->device upload for lengths
+    # the TuRBO ladder already visited (safe to re-pass: no donation, see
+    # PlanPrepToken).
+    if tr_length is None:
+        tr = prep.default_tr
+    else:
+        tr = tr_cache.get(tr_length) if tr_cache is not None else None
+        if tr is None:
+            tr = jnp.asarray(tr_length, jnp.float32)
+            if tr_cache is not None:
+                if len(tr_cache) >= _TR_CACHE_MAX:
+                    tr_cache.clear()
+                tr_cache[tr_length] = tr
+    arrays = (key, x, y, mask, jnp.asarray(best_x), warm, tr)
+    return FusedPlan(prep.signature, arrays, prep.statics, int(num))
+
+
 def make_fused_plan(
     key,
     x,
@@ -1053,6 +1190,7 @@ def make_fused_plan(
     y_transform="none",
     fixed_tail_cols=0,
     mesh=None,
+    prep_token=None,
 ):
     """Fold the per-round dynamics (warm refit steps, q bucket, tr_length
     boxing) into a :class:`FusedPlan`.  This is THE prep path — the
@@ -1067,10 +1205,39 @@ def make_fused_plan(
     bench's ``dispatch`` stage.  The cache key folds in everything the
     cached values depend on — including ``warm_state is None`` (fit-steps
     selection) — so a hit can never change the plan that would have been
-    built."""
+    built.
+
+    ``prep_token`` (a :class:`PlanPrepToken` private to one algorithm
+    instance) layers the steady-path shortcut on top: when the token's
+    pinned fast key still matches, the 16-element ``prep_key`` build and
+    cache probe are skipped entirely and the round goes straight to the
+    shared plan tail (:func:`_finish_plan`) — the ``dispatch_us_saved``
+    line of the bench breakdown.  The fast key deliberately omits the
+    instance-frozen statics; see :class:`PlanPrepToken` for the contract.
+    """
     t0 = time.perf_counter_ns()
-    width = x.shape[1]
     warm_is_none = warm_state is None
+    fast_key = None
+    if prep_token is not None:
+        fast_key = (
+            tuple(x.shape),
+            _next_pow2(num, floor=8),
+            warm_is_none,
+            local_sigma,
+            fit_steps,
+            refit_steps,
+        )
+        pinned = prep_token.pinned
+        if pinned is not None and pinned[0] == fast_key:
+            plan = _finish_plan(
+                pinned[1], key, x, y, mask, best_x, warm_state,
+                warm_is_none, num, tr_length, prep_token.tr_cache,
+                kernel, y_transform,
+            )
+            _DISPATCH_PREP_STATS["hits"] += 1
+            _DISPATCH_PREP_STATS["hit_ns"] += time.perf_counter_ns() - t0
+            return plan
+    width = x.shape[1]
     prep_key = (
         tuple(x.shape),
         _next_pow2(num, floor=8),
@@ -1142,28 +1309,18 @@ def make_fused_plan(
         hit = False
     else:
         hit = True
-    warm = prep.cold_hypers if warm_is_none else warm_state.hypers
-    if prep.split_fit:
-        warm = _fit_gp_host(
-            x, y, mask, warm,
-            kernel=kernel,
-            fit_steps=prep.host_fit_steps,
-            y_transform=y_transform,
-        )
-    arrays = (
-        key,
-        x,
-        y,
-        mask,
-        jnp.asarray(best_x),
-        warm,
-        # Dynamic (traced) so success/failure box resizing never recompiles;
-        # always an array — jit caches on dtype, not value.
-        prep.default_tr
-        if tr_length is None
-        else jnp.asarray(tr_length, jnp.float32),
+    if prep_token is not None:
+        # One-tuple swap: a concurrent fast-path reader sees either the old
+        # or the new (key, prep) pair, never a torn mix.
+        prep_token.pinned = (fast_key, prep)
+    plan = _finish_plan(
+        prep, key, x, y, mask, best_x, warm_state, warm_is_none, num,
+        tr_length, prep_token.tr_cache if prep_token is not None else None,
+        kernel, y_transform,
     )
-    plan = FusedPlan(prep.signature, arrays, prep.statics, int(num))
+    if prep_token is not None:
+        _DISPATCH_PREP_STATS["misses"] += 1
+        _DISPATCH_PREP_STATS["miss_ns"] += time.perf_counter_ns() - t0
     if hit:
         _PLAN_PREP_STATS["hits"] += 1
         _PLAN_PREP_STATS["hit_ns"] += time.perf_counter_ns() - t0

@@ -1,4 +1,4 @@
-"""Hot-path vectorization rule (``PERF001``).
+"""Hot-path vectorization rules (``PERF001``, ``PERF002``).
 
 ISSUE 13 burned the per-trial python work out of the steady-state producer
 round: the cube<->params codec runs one numpy/lookup-table pass per
@@ -22,6 +22,18 @@ functions in ``HOT_FUNCTIONS``.  A loop is batch-sized when its iterable
 resolves — through ``enumerate``/``zip``/``reversed``/slices — to one of
 the function's parameters with a batch-shaped name (``BATCH_NAMES``), or
 to a local assigned from one.
+
+``PERF002`` pins the host-tail endgame's dispatch-prep discipline the same
+way: inside the declared hot-path PREP functions (the per-round plan
+builders between ``suggest`` and the device dispatch), rebuilding a
+signature-invariant product — a statics/kwargs dict, a signature
+string/tuple — from scratch every round is flagged unless the build rides
+a cache: lexically guarded by a conditional on a value loaded from a
+``*cache*``/``*token*`` attribute or global (the ``self._step_kw_cache``
+/ ``_PLAN_PREP_CACHE``/``PlanPrepToken`` shapes in ``algo/tpu_bo.py`` are
+the exemplars).  Per-round ARRAY tuples (the donated device operands) are
+not rebuild products — they change every round by definition — so the
+rule keys on the declared product names, not on every tuple literal.
 """
 
 import ast
@@ -188,4 +200,142 @@ class PerTrialLoopInHotPath(Rule):
                     )
 
 
-PERF_RULES = (PerTrialLoopInHotPath,)
+#: Per-round prep functions whose signature-invariant products must ride a
+#: cache: module-level names and method names (matched on ANY class — prep
+#: methods are declared by name, like HOT_FUNCTIONS, so algorithm
+#: subclasses inherit the discipline without registration).
+HOT_PREP_FUNCTIONS = {"make_fused_plan"}
+HOT_PREP_METHODS = {"fused_step_plan", "_gp_plan"}
+
+#: Local names that denote a signature-invariant prep product.  Tight on
+#: purpose: ``arrays``/``prep_key``/``fast_key`` are per-round by nature
+#: (fresh device operands, the cache's own probe key) and must stay quiet.
+PREP_PRODUCT_NAMES = frozenset({"statics", "signature", "step_kw", "kw"})
+
+#: Identifier substrings that mark a value as cache-loaded: a conditional
+#: on such a value is the cache guard the rebuild must sit under.
+_CACHE_MARKERS = ("cache", "token", "memo")
+
+
+class UncachedPrepRebuild(Rule):
+    id = "PERF002"
+    name = "uncached-prep-rebuild-in-hot-path"
+    description = (
+        "per-round rebuild of a signature-invariant prep product (statics/"
+        "kwargs dict, signature string or tuple) inside a hot-path plan-prep "
+        "function, outside any cache guard; pin it behind a *_cache "
+        "attribute / prep token (suppress with a reason if the rebuild is "
+        "argued)"
+    )
+
+    # --- hot-path discovery -------------------------------------------------
+    def _hot_functions(self, tree):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if (
+                        isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and item.name in HOT_PREP_METHODS
+                        and not item.name.endswith(_REFERENCE_SUFFIX)
+                    ):
+                        yield node.name, item
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if (
+                    node.name in HOT_PREP_FUNCTIONS
+                    and not node.name.endswith(_REFERENCE_SUFFIX)
+                ):
+                    yield None, node
+
+    # --- cache-loaded names -------------------------------------------------
+    @staticmethod
+    def _is_cacheish(identifier):
+        lowered = identifier.lower()
+        return any(marker in lowered for marker in _CACHE_MARKERS)
+
+    def _cache_loaded_names(self, fn):
+        """Locals assigned from an expression that touches a cache/token —
+        ``prep = _PLAN_PREP_CACHE.get(key)``, ``kw = self._step_kw_cache``,
+        ``pinned = prep_token.pinned``.  A conditional on one of these IS
+        the cache guard."""
+        names = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            touches_cache = any(
+                (isinstance(sub, ast.Name) and self._is_cacheish(sub.id))
+                or (isinstance(sub, ast.Attribute) and self._is_cacheish(sub.attr))
+                for sub in ast.walk(node.value)
+            )
+            if touches_cache:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        names.add(target.id)
+        return names
+
+    # --- rebuild products ---------------------------------------------------
+    @staticmethod
+    def _is_rebuild_expr(node):
+        """A from-scratch build of a prep product: a dict literal/``dict()``
+        call, an f-string, or a tuple literal."""
+        if isinstance(node, (ast.Dict, ast.DictComp, ast.JoinedStr, ast.Tuple)):
+            return True
+        if isinstance(node, ast.Call):
+            return (dotted_name(node.func) or "").split(".")[-1] == "dict"
+        return False
+
+    # --- check --------------------------------------------------------------
+    def check(self, module):
+        seen = set()
+        for owner, fn in self._hot_functions(module.tree):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            guards = self._cache_loaded_names(fn)
+            where = f"{owner}.{fn.name}" if owner else fn.name
+            yield from self._scan(fn.body, guarded=False, guards=guards,
+                                  where=where, path=module.path)
+
+    def _scan(self, stmts, guarded, guards, where, path):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested defs are their own (non-hot) scope
+            if isinstance(stmt, ast.Assign) and not guarded:
+                for target in stmt.targets:
+                    if (
+                        isinstance(target, ast.Name)
+                        and target.id in PREP_PRODUCT_NAMES
+                        and self._is_rebuild_expr(stmt.value)
+                    ):
+                        yield Diagnostic(
+                            path,
+                            stmt.lineno,
+                            stmt.col_offset,
+                            self.id,
+                            f"'{target.id}' is rebuilt from scratch every "
+                            f"round in hot-path prep '{where}' with no cache "
+                            "guard; load it from a *_cache attribute / prep "
+                            "token and rebuild only on miss (suppress with a "
+                            "reason if the per-round rebuild is argued)",
+                        )
+            if isinstance(stmt, ast.If):
+                test_guards = guarded or any(
+                    isinstance(sub, ast.Name) and sub.id in guards
+                    for sub in ast.walk(stmt.test)
+                )
+                yield from self._scan(stmt.body, test_guards, guards, where, path)
+                yield from self._scan(stmt.orelse, test_guards, guards, where, path)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                yield from self._scan(stmt.body, guarded, guards, where, path)
+                yield from self._scan(stmt.orelse, guarded, guards, where, path)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self._scan(stmt.body, guarded, guards, where, path)
+            elif isinstance(stmt, ast.Try):
+                for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                    yield from self._scan(block, guarded, guards, where, path)
+                for handler in stmt.handlers:
+                    yield from self._scan(handler.body, guarded, guards,
+                                          where, path)
+
+
+PERF_RULES = (PerTrialLoopInHotPath, UncachedPrepRebuild)

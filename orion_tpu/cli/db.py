@@ -105,6 +105,30 @@ def add_subparser(subparsers):
     )
     rebalance_p.set_defaults(func=main_rebalance)
 
+    migrate_ids_p = sub.add_parser(
+        "migrate-ids",
+        help="rewrite trial ids to a new identity scheme (default: the "
+        "byte-hash cube_hash scheme) experiment by experiment — copy under "
+        "new ids -> byte-verify non-id fields -> flip id_scheme -> delete "
+        "originals; crash-resumable, works on every backend and across "
+        "the sharded router (see docs/multi_node.md)",
+    )
+    _common(migrate_ids_p)
+    migrate_ids_p.add_argument(
+        "--scheme", default="cube_hash", choices=["md5", "cube_hash"],
+        help="target id scheme (default: cube_hash)",
+    )
+    migrate_ids_p.add_argument(
+        "-n", "--name", default=None, metavar="NAME",
+        help="migrate only this experiment (default: every experiment "
+        "whose scheme differs)",
+    )
+    migrate_ids_p.add_argument(
+        "--dry-run", action="store_true",
+        help="print the plan and exit without rewriting anything",
+    )
+    migrate_ids_p.set_defaults(func=main_migrate_ids)
+
     backup_p = sub.add_parser(
         "backup",
         help="stream one consistent seq/epoch-stamped snapshot per shard "
@@ -821,6 +845,43 @@ def main_rebalance(args):
     rebalancer.run(plan)
     moved = len(plan.moves)
     print(f"rebalanced {moved} experiment(s); placement == ring again")
+    return 0
+
+
+def main_migrate_ids(args):
+    """`db migrate-ids`: rewrite trial ids to ``--scheme`` through the
+    crash-resumable copy/verify/flip/delete state machine
+    (storage/migrate_ids.py).  Re-run after any crash: the plan is
+    recomputed from the standing migration docs and resumes.  Run with no
+    active producers on the affected experiments."""
+    import sys
+
+    from orion_tpu.storage.migrate_ids import IdMigrator
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    config = load_cli_config(args)
+    storage = setup_storage(config["storage"], force=True)
+    migrator = IdMigrator(storage, to_scheme=args.scheme)
+    rows = migrator.plan(experiment=args.name)
+    if not rows:
+        print(f"nothing to migrate: every experiment already uses {args.scheme!r}")
+        return 0
+    print(f"migrate-ids plan: {len(rows)} experiment(s) -> {args.scheme!r}")
+    for row in rows:
+        print(f"  {row.describe()}")
+    if args.dry_run:
+        return 0
+    try:
+        migrator.run(rows)
+    except DatabaseError as exc:
+        print(f"ERROR: migrate-ids failed: {exc}", file=sys.stderr)
+        print("re-run `orion-tpu db migrate-ids` to resume", file=sys.stderr)
+        return 1
+    rewritten = sum(row.rewritten for row in rows)
+    print(
+        f"migrated {len(rows)} experiment(s) ({rewritten} doc(s) rewritten); "
+        "run `orion-tpu audit --all` to verify"
+    )
     return 0
 
 

@@ -225,6 +225,9 @@ def _telemetry_section(experiment, per_worker=False, docs=None):
                     + _flush_age_suffix(doc, now)
                 )
                 lines.extend(_snapshot_lines(doc))
+                ratio_line = _ratio_line(doc.get("histograms"))
+                if ratio_line:
+                    lines.append(ratio_line)
             return lines
         merged = merge_snapshots(docs)
         stale = [
@@ -234,6 +237,9 @@ def _telemetry_section(experiment, per_worker=False, docs=None):
             and _flush_age(doc, now) > _stale_after()
         ]
         lines = [f"workers reporting: {len(docs)}"] + _snapshot_lines(merged)
+        ratio_line = _ratio_line(merged.get("histograms"))
+        if ratio_line:
+            lines.append(ratio_line)
         if stale:
             # The merged view MAX-combines gauges, so a quiet worker's
             # numbers survive indefinitely — name who went quiet.
@@ -250,6 +256,22 @@ def _stale_after():
     from orion_tpu.cli.top import STALE_AFTER
 
     return STALE_AFTER
+
+
+def _ratio_line(histograms):
+    """``host/device ratio: 1.12 (budget 2.25x)`` from the round vs
+    device-window histogram means — the same per-worker number ``orion-tpu
+    top`` shows in its ``h/d`` column, against the same
+    ``orion_tpu.hostbudget`` bar the bench gate and doctor DX004 use."""
+    from orion_tpu.cli.top import _host_device_ratio
+    from orion_tpu.hostbudget import round_budget_factor
+
+    ratio = _host_device_ratio(histograms)
+    if ratio is None:
+        return None
+    budget = round_budget_factor()
+    marker = "  HOST-BUDGET BREACH" if ratio > budget else ""
+    return f"host/device ratio: {ratio:g} (budget {budget:g}x){marker}"
 
 
 def _flush_age(doc, now):

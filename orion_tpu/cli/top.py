@@ -96,6 +96,26 @@ def _merged_percentile(histograms, prefix, p):
     return worst
 
 
+def _host_device_ratio(histograms):
+    """Mean ``producer.round`` / mean ``device.dispatch`` for one worker —
+    the live wall-vs-device number next to the mem column.  The round
+    CONTAINS the device window, so a healthy worker sits near 1.0 and the
+    budget bar is ``1 + host_budget_factor()`` (orion_tpu.hostbudget —
+    the SAME knob the bench gate and doctor DX004 read).  None when either
+    histogram has no samples yet."""
+    ratio = None
+    round_hist = (histograms or {}).get("producer.round") or {}
+    device_hist = (histograms or {}).get("device.dispatch") or {}
+    round_count = int(round_hist.get("count", 0))
+    device_count = int(device_hist.get("count", 0))
+    if round_count > 0 and device_count > 0:
+        round_mean = float(round_hist.get("sum", 0.0)) / round_count
+        device_mean = float(device_hist.get("sum", 0.0)) / device_count
+        if device_mean > 0:
+            ratio = round(round_mean / device_mean, 2)
+    return ratio
+
+
 def _counter_sum(counters, *needles):
     """Sum every counter whose name contains one of ``needles`` (the
     reconnects counter is per-backend-prefixed: ``storage.network
@@ -149,6 +169,9 @@ def snapshot_top(experiment, now=None):
             "mem_mb": (
                 round(float(mem_bytes) / 1e6, 3) if mem_bytes is not None else None
             ),
+            # Live wall-vs-device: mean producer round over mean device
+            # window — the per-worker view of the bench's host-budget gate.
+            "host_device_ratio": _host_device_ratio(histograms),
             "last_seen_s": round(now - float(doc.get("time") or now), 3),
             # Age of the last metrics flush specifically (last_seen_s is
             # min-merged with health below): the staleness signal.
@@ -184,6 +207,7 @@ def snapshot_top(experiment, now=None):
                 "reconnects": 0,
                 "retraces": 0,
                 "mem_mb": None,
+                "host_device_ratio": None,
                 "last_seen_s": None,
                 "metrics_age_s": None,
                 "health_age_s": None,
@@ -333,14 +357,18 @@ def render_top(snap):
     if snap["regret_curve"]:
         lines.append(f"objective  {sparkline(snap['regret_curve'])}")
     lines.append("")
+    from orion_tpu.hostbudget import round_budget_factor
+
+    budget = round_budget_factor()
     header = (
         f"{'worker':<24} {'rounds':>6} {'rate/s':>7} {'age':>7} {'hb lag':>7} "
-        f"{'sto p99':>8} {'mem MB':>8} {'retry':>5} {'reconn':>6} "
+        f"{'sto p99':>8} {'mem MB':>8} {'h/d':>6} {'retry':>5} {'reconn':>6} "
         f"{'best_y':>12} {'gp_mll':>8} {'tr_len':>6}"
     )
     lines.append(header)
     lines.append("-" * len(header))
     stale_workers = []
+    over_budget = []
     for worker, row in sorted(snap["workers"].items()):
         health = row.get("health") or {}
 
@@ -353,6 +381,13 @@ def render_top(snap):
         age_cell = (fmt(age, "6.1f") + ("!" if row.get("stale") else " "))[:7]
         if row.get("stale"):
             stale_workers.append(worker)
+        # `!` marks a worker whose mean round exceeds the host-budget bar
+        # (1 + host_budget_factor(), same knob as the bench gate / DX004).
+        ratio = row.get("host_device_ratio")
+        breached = ratio is not None and ratio > budget
+        ratio_cell = (fmt(ratio, "5.2f") + ("!" if breached else " "))[:6]
+        if breached:
+            over_budget.append(worker)
         lines.append(
             f"{worker:<24} {row['rounds']:>6} "
             f"{fmt(row['round_rate'], '7.2f'):>7} "
@@ -360,6 +395,7 @@ def render_top(snap):
             f"{fmt(row['heartbeat_lag_s'], '6.1f'):>7} "
             f"{fmt(row['storage_p99_ms'], '7.1f'):>8} "
             f"{fmt(row.get('mem_mb'), '8.1f'):>8} "
+            f"{ratio_cell:>6} "
             f"{row['retries']:>5} {row['reconnects']:>6} "
             f"{fmt(health.get('best_y'), '12.5g'):>12} "
             f"{fmt(health.get('gp_mll'), '8.3f'):>8} "
@@ -369,6 +405,11 @@ def render_top(snap):
         lines.append(
             f"STALE (no flush for > {STALE_AFTER:g}s): "
             + ", ".join(stale_workers)
+        )
+    if over_budget:
+        lines.append(
+            f"HOST-BUDGET BREACH (round > {budget:g}x device window): "
+            + ", ".join(over_budget)
         )
     return "\n".join(lines)
 

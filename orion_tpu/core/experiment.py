@@ -13,7 +13,7 @@ import time
 
 from orion_tpu.algo.base import create_algo
 from orion_tpu.core.strategy import create_strategy
-from orion_tpu.core.trial import Trial
+from orion_tpu.core.trial import ID_SCHEMES, Trial, compute_scheme_ids
 from orion_tpu.space.dsl import build_space
 from orion_tpu.telemetry import TELEMETRY
 from orion_tpu.utils.exceptions import (
@@ -56,6 +56,15 @@ class Experiment:
         self.algo_config = config.get("algorithms", "random")
         self.strategy_config = config.get("strategy", "MaxParallelStrategy")
         self.refers = dict(config.get("refers", {}))
+        # Trial identity scheme — STORED identity (unlike heartbeat): every
+        # consumer must compute the same ids, so the scheme rides the
+        # experiment doc.  Absent = md5, which keeps every pre-existing
+        # experiment resuming byte-identically; `db migrate-ids` flips it.
+        self.id_scheme = config.get("id_scheme") or "md5"
+        if self.id_scheme not in ID_SCHEMES:
+            raise ValueError(
+                f"Unknown id_scheme {self.id_scheme!r}; one of {ID_SCHEMES}"
+            )
         self._last_lost_sweep = float("-inf")
         self.priors = dict(config.get("priors") or config.get("metadata", {}).get("priors", {}))
         self.space = build_space(self.priors) if self.priors else None
@@ -115,7 +124,7 @@ class Experiment:
         return self._storage
 
     def configuration(self):
-        return {
+        out = {
             "name": self.name,
             "version": self.version,
             "metadata": self.metadata,
@@ -128,6 +137,12 @@ class Experiment:
             "priors": self.priors,
             "refers": self.refers,
         }
+        if self.id_scheme != "md5":
+            # Conditional so default-scheme experiments' configuration stays
+            # byte-for-byte what every earlier release produced (EVC conflict
+            # detection and stored-config comparisons ride this dict).
+            out["id_scheme"] = self.id_scheme
+        return out
 
     # --- trial operations ---------------------------------------------------
     def fix_lost_trials(self):
@@ -183,25 +198,48 @@ class Experiment:
             trial.working_dir = self.working_dir
         return trials
 
+    def _stamp_scheme_ids(self, trials, lie=False):
+        """Freeze each trial's id under this experiment's ``id_scheme``.
+
+        md5 needs no stamp (the ``Trial.id`` property computes it lazily);
+        cube_hash ids ride ``_id_override`` so every creation path —
+        single-trial registration, lies, the columnar batch — emits ids
+        under ONE scheme.  A mixed-scheme experiment would silently defeat
+        the duplicate-point unique index."""
+        if self.id_scheme == "md5" or not trials:
+            return trials
+        ids = compute_scheme_ids(
+            self._id,
+            [trial.params for trial in trials],
+            lie=lie,
+            id_scheme=self.id_scheme,
+            space=self.space,
+        )
+        for trial, _id in zip(trials, ids):
+            trial._id_override = _id
+        return trials
+
     def register_trial(self, trial, parents=()):
         trial.experiment = self._id
         trial.parents = list(parents)
         trial.submit_time = time.time()
+        self._stamp_scheme_ids([trial])
         self._storage.register_trial(trial)
         return trial
 
     def prepare_trials(self, trials, parents=()):
         """Stamp the identity fields (experiment, lineage parents, submit
         time) WITHOUT writing storage.  This finalizes each trial's id
-        (the md5 covers experiment + params), so a caller may key caches
-        or dispatch device work against the real ids BEFORE the storage
-        commit — the producer's pipelined commit path does exactly that."""
+        (the scheme hash covers experiment + params), so a caller may key
+        caches or dispatch device work against the real ids BEFORE the
+        storage commit — the producer's pipelined commit path does exactly
+        that."""
         now = time.time()
         for trial in trials:
             trial.experiment = self._id
             trial.parents = list(parents)
             trial.submit_time = now
-        return trials
+        return self._stamp_scheme_ids(trials)
 
     def register_trials(self, trials, parents=(), prepared=False):
         """Batch registration; returns per-trial outcomes (the trial, or its
@@ -216,7 +254,12 @@ class Experiment:
         """Columnar twin of :meth:`prepare_trials`: stamp a
         :class:`~orion_tpu.core.trial.TrialBatch`'s identity fields and
         freeze its ids WITHOUT writing storage."""
-        return batch.prepare(self._id, parents=parents)
+        return batch.prepare(
+            self._id,
+            parents=parents,
+            id_scheme=self.id_scheme,
+            space=self.space,
+        )
 
     def register_trial_batch(self, batch, parents=(), prepared=False):
         """Columnar batch registration: the round's documents are built in
@@ -235,6 +278,7 @@ class Experiment:
 
     def register_lie(self, trial):
         trial.experiment = self._id
+        self._stamp_scheme_ids([trial], lie=True)
         self._storage.register_lie(trial)
         return trial
 

@@ -123,11 +123,19 @@ class HostBudgetBreach(DoctorRule):
         "again — the wall-=-device contract is regressing."
     )
 
-    #: Mean producer.round vs mean device.dispatch.  The device window
-    #: deliberately OVERLAPS host work (the pipelined commit), so a
-    #: healthy round's wall ≈ its window; 3x is well past overlap slack.
-    FACTOR = 3.0
+    #: Mean producer.round vs mean device.dispatch.  The round CONTAINS
+    #: the device window, so the bench's host budget of F x device bounds
+    #: a healthy round at (1 + F) x device — the threshold is DERIVED from
+    #: the same ``orion_tpu.hostbudget`` knob the bench gate and
+    #: ``orion-tpu top`` read (ORION_TPU_HOST_BUDGET_FACTOR overrides all
+    #: three at once), so the doctor can never drift from the gate.
     MIN_SAMPLES = 4
+
+    @property
+    def FACTOR(self):
+        from orion_tpu.hostbudget import round_budget_factor
+
+        return round_budget_factor()
 
     def evaluate(self, snapshot):
         round_mean = snapshot.histogram_mean("producer.round")
@@ -139,12 +147,13 @@ class HostBudgetBreach(DoctorRule):
             < self.MIN_SAMPLES
         ):
             return
-        if round_mean > self.FACTOR * device_mean:
+        factor = self.FACTOR
+        if round_mean > factor * device_mean:
             yield self.finding(
                 f"mean round {round_mean * 1e3:.1f}ms vs mean device window "
-                f"{device_mean * 1e3:.1f}ms (> {self.FACTOR:g}x): the round "
-                "is host-dominated — see breakdown_ms / `orion-tpu trace "
-                "--attribute` for which stage grew",
+                f"{device_mean * 1e3:.1f}ms (> {factor:g}x = 1 + host-budget "
+                "factor): the round is host-dominated — see breakdown_ms / "
+                "`orion-tpu trace --attribute` for which stage grew",
                 value=round_mean / device_mean,
             )
 

@@ -891,7 +891,26 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         self._maybe_prewarm_width(chunk[0], width)
         for job, (rows, state) in zip(chunk, results):
             job.exec_algo.consume_fused_step(state)
-            self._finish_suggest(job, cube=np.asarray(rows))
+            finish = getattr(job.exec_algo, "finish_fused_rows", None)
+            if finish is not None:
+                # Multi-fidelity algorithms (asha_bo): raw cube rows would
+                # bypass fidelity assignment and rung pre-registration —
+                # the hook runs the algorithm's own point-assignment path
+                # and the reply carries full params, exactly as the plain
+                # dispatch would have.
+                try:
+                    params = finish(np.asarray(rows))
+                except Exception as exc:
+                    log.exception(
+                        "finish_fused_rows failed for %r", job.tenant.name
+                    )
+                    self._finish(
+                        job.item, error_reply(type(exc).__name__, str(exc))
+                    )
+                    continue
+                self._finish_suggest(job, params=params)
+            else:
+                self._finish_suggest(job, cube=np.asarray(rows))
 
     def _dispatch_plain(self, job):
         """Non-fused suggest (random-init phase, host-scheduled algorithms,

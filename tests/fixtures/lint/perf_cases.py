@@ -1,11 +1,20 @@
-"""PERF001 fixtures: per-trial loops in producer/codec hot-path functions.
+"""PERF001/PERF002 fixtures: per-trial loops and uncached prep rebuilds in
+declared hot-path functions.
 
-Bad shapes: for/comprehension iterating a q-sized batch (a batch-named
-parameter, or a local derived from one through enumerate/zip/slices)
-inside a declared hot-path function.  Good shapes: per-DIM loops (the
-desired vectorized form), reference twins (retained differential anchors),
-suppressions with the argued plugin-compat reason, and batch loops in
-NON-hot-path functions.
+PERF001 bad shapes: for/comprehension iterating a q-sized batch (a
+batch-named parameter, or a local derived from one through
+enumerate/zip/slices) inside a declared hot-path function.  Good shapes:
+per-DIM loops (the desired vectorized form), reference twins (retained
+differential anchors), suppressions with the argued plugin-compat reason,
+and batch loops in NON-hot-path functions.
+
+PERF002 bad shapes: a statics/kwargs dict or signature string/tuple built
+from scratch every round inside a declared plan-prep function.  Good
+shapes: the same build behind a cache guard (a conditional on a value
+loaded from a ``*_cache`` attribute / prep token — the
+``self._step_kw_cache`` / ``_PLAN_PREP_CACHE`` exemplars in
+``algo/tpu_bo.py``), per-round array tuples under non-product names, and
+identical builds in NON-prep functions.
 """
 
 
@@ -66,3 +75,44 @@ def compute_batch_ids_reference(experiment, params_rows):
 def free_function(trials):
     # Module-level function NOT in the hot-path set: quiet.
     return [t for t in trials]
+
+
+def make_fused_plan(key, x, num, n_candidates, kernel):
+    statics = dict(q=num, n_candidates=n_candidates, kernel=kernel)  # expect: PERF002
+    signature = (tuple(x.shape), kernel)  # expect: PERF002
+    # Per-round device operands under a non-product name: quiet (they
+    # change every round by definition).
+    arrays = (key, x)
+    return statics, signature, arrays
+
+
+class CachedPlanner:
+    def fused_step_plan(self, num):
+        # The exemplar shape: load from the cache attribute, rebuild only
+        # on miss — both builds sit under the cache guard, quiet.
+        step_kw = self._step_kw_cache
+        if step_kw is None:
+            step_kw = dict(self._step_kw())
+            self._step_kw_cache = step_kw
+        prep = self._prep_token.pinned
+        if prep is None:
+            statics = dict(step_kw)
+            signature = (num, tuple(sorted(statics)))
+            self._prep_token.pinned = (signature, statics)
+        return self._build(num, step_kw)
+
+
+class UncachedPlanner:
+    def _gp_plan(self, num):
+        kw = dict(self._step_kw())  # expect: PERF002
+        # An unrelated conditional is NOT a cache guard.
+        if num > 8:
+            signature = f"plan-{num}-{self.kernel}"  # expect: PERF002
+            return signature, kw
+        return None, kw
+
+    def helper_plan(self, num):
+        # Not a declared prep function: per-call builds are its business.
+        statics = dict(q=num)
+        signature = (num,)
+        return statics, signature

@@ -297,6 +297,43 @@ def test_quiet_fixture_stays_quiet(rule_id):
     )
 
 
+def test_host_budget_knob_drives_dx004_threshold(monkeypatch):
+    """DX004's bar is 1 + the host-budget factor — the SAME knob the bench
+    gate and `top`/`info` read (orion_tpu/hostbudget.py), env-overridable
+    at call time."""
+    from orion_tpu.hostbudget import (
+        DEFAULT_HOST_BUDGET_FACTOR,
+        ENV_VAR,
+        host_budget_factor,
+        round_budget_factor,
+    )
+
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    assert host_budget_factor() == DEFAULT_HOST_BUDGET_FACTOR == 1.25
+    assert round_budget_factor() == 2.25
+    monkeypatch.setenv(ENV_VAR, "0.5")
+    assert host_budget_factor() == 0.5  # read at call time, not import time
+    assert round_budget_factor() == 1.5
+    monkeypatch.setenv(ENV_VAR, "not-a-number")
+    assert host_budget_factor() == DEFAULT_HOST_BUDGET_FACTOR
+
+    # Round = 2.0x device: inside the default 2.25x bar, outside a
+    # tightened 1.5x one — DX004 must follow the knob, not a literal.
+    monkeypatch.delenv(ENV_VAR, raising=False)
+    snapshot = Snapshot(
+        metrics=_metrics(
+            histograms={
+                "producer.round": _hist(10, 0.020),
+                "device.dispatch": _hist(10, 0.010),
+            }
+        ),
+        now=NOW,
+    )
+    assert "DX004" not in {f.rule_id for f in run_rules(snapshot).findings}
+    monkeypatch.setenv(ENV_VAR, "0.5")
+    assert "DX004" in {f.rule_id for f in run_rules(snapshot).findings}
+
+
 def test_every_registered_rule_has_a_fixture_and_a_resolvable_runbook(repo_root):
     """The completeness scan (lint-rule coverage-scan discipline): a rule
     added without a firing fixture, or whose runbook anchor points at no

@@ -1,0 +1,306 @@
+"""cube_hash trial identity + `db migrate-ids` (storage/migrate_ids.py).
+
+Two contracts under test.  First, the identity itself: cube_hash ids are a
+pure function of (experiment, canonical cube row, lie marker) — stable,
+collision-free, lie-sensitive, distinct from the md5 scheme, and falling
+back deterministically to md5 whenever no space can encode the params
+(``compute_scheme_ids`` docstring).  Second, the migrator: pin → copy →
+verify → flip → delete must be exactly-once under a crash at ANY stage
+boundary (the ``crash_at`` hook), byte-identical on every non-id field,
+clean-audited, and must route correctly through a sharded topology (every
+op carries the ``experiment`` key).
+"""
+
+import pytest
+
+from orion_tpu.core.trial import (
+    Trial,
+    compute_batch_ids,
+    compute_cube_ids,
+    compute_scheme_ids,
+)
+from orion_tpu.space.dsl import build_space
+from orion_tpu.storage import create_storage
+from orion_tpu.storage.audit import audit_experiment
+from orion_tpu.storage.migrate_ids import MIGRATION_COLLECTION, IdMigrator
+
+PRIORS = {"x0": "uniform(0, 1)", "x1": "uniform(0, 1)", "x2": "uniform(0, 1)"}
+
+
+def _rows(space, n, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    cube = rng.uniform(size=(n, len(PRIORS))).astype(np.float32)
+    return space.arrays_to_params(space.decode_flat_np(cube))
+
+
+# --- the identity ------------------------------------------------------------
+
+
+def test_cube_hash_differential_pin():
+    space = build_space(PRIORS)
+    rows = _rows(space, 256)
+    exp = "pin-exp"
+
+    ids = compute_scheme_ids(exp, rows, id_scheme="cube_hash", space=space)
+    # The scheme helper IS compute_cube_ids over the canonical encode.
+    assert ids == compute_cube_ids(exp, space.params_to_cube(rows))
+    # Pure function: stable across calls, collision-free across the batch.
+    assert ids == compute_scheme_ids(
+        exp, rows, id_scheme="cube_hash", space=space
+    )
+    assert len(set(ids)) == len(rows)
+    # Identity inputs all matter: experiment prefix, lie marker, the row.
+    assert ids != compute_scheme_ids(
+        "other-exp", rows, id_scheme="cube_hash", space=space
+    )
+    lie_ids = compute_scheme_ids(
+        exp, rows, lie=True, id_scheme="cube_hash", space=space
+    )
+    assert not set(ids) & set(lie_ids)
+    # Distinct scheme from md5 — no accidental cross-scheme collisions.
+    md5_ids = compute_batch_ids(exp, rows)
+    assert not set(ids) & set(md5_ids)
+    # No space -> deterministic md5 fallback, bit-identical to Trial.compute_id.
+    fallback = compute_scheme_ids(exp, rows, id_scheme="cube_hash", space=None)
+    assert fallback == md5_ids
+    assert fallback[:8] == [
+        Trial.compute_id(exp, row) for row in rows[:8]
+    ]
+
+
+def test_cube_hash_falls_back_per_row_on_unencodable_params():
+    space = build_space(PRIORS)
+    rows = _rows(space, 4)
+    # A legacy doc whose params the codec cannot encode: the WHOLE batch
+    # answers via md5 (deterministic — duplicate detection stays intact).
+    legacy = rows + [{"unknown_dim": 3.5}]
+    ids = compute_scheme_ids("exp", legacy, id_scheme="cube_hash", space=space)
+    assert ids == compute_batch_ids("exp", legacy)
+
+
+# --- migration on a live experiment -----------------------------------------
+
+
+def _seed_experiment(storage, rounds=2, q=4):
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.core.trial import Result
+
+    exp = build_experiment(
+        storage,
+        "mig-exp",
+        priors=dict(PRIORS),
+        max_trials=100,
+        algorithms="random",
+        pool_size=q,
+    ).instantiate(seed=7)
+    producer = Producer(exp)
+    for round_ in range(rounds):
+        producer.update()
+        assert producer.produce(q) == q
+        if round_ == 0:  # complete the first round so lineage/objectives exist
+            for trial in exp.fetch_trials():
+                storage.set_trial_status(trial, "reserved", was="new")
+                storage.update_completed_trial(
+                    trial, [Result("obj", "objective", 0.5)]
+                )
+    return exp
+
+
+def _expected_ids(db, exp_id, space):
+    docs = db.read("trials", {"experiment": exp_id})
+    return set(
+        compute_scheme_ids(
+            exp_id,
+            [d.get("params") or {} for d in docs],
+            id_scheme="cube_hash",
+            space=space,
+        )
+    )
+
+
+def _assert_migrated(storage, exp_id):
+    db = storage.db
+    exp_doc = db.read("experiments", {"_id": exp_id})[0]
+    assert exp_doc.get("id_scheme") == "cube_hash"
+    space = build_space(exp_doc["priors"])
+    docs = db.read("trials", {"experiment": exp_id})
+    expected = _expected_ids(db, exp_id, space)
+    got = {d["_id"] for d in docs}
+    # Ids actually moved to the cube scheme (guards against a silent md5
+    # fallback making this whole test vacuous).
+    assert got == expected
+    assert not got & set(
+        compute_batch_ids(exp_id, [d.get("params") or {} for d in docs])
+    )
+    # Nothing half-finished left behind; the experiment audits clean.
+    assert db.read(MIGRATION_COLLECTION, {}) == []
+    report = audit_experiment(storage, exp_doc, lost_timeout=3600.0)
+    assert report.ok, report.violations
+    return exp_doc
+
+
+def test_migration_roundtrip_then_producing_resumes_clean():
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.core.producer import Producer
+    from orion_tpu.storage.documents import dumps_canonical
+
+    storage = create_storage({"type": "memory"})
+    exp = _seed_experiment(storage)
+    db = storage.db
+    before = {
+        dumps_canonical({k: v for k, v in d.items() if k not in ("_id", "parents")})
+        for d in db.read("trials", {"experiment": exp.id})
+    }
+    old_ids = {d["_id"] for d in db.read("trials", {"experiment": exp.id})}
+
+    migrator = IdMigrator(storage)
+    rows = migrator.plan()
+    assert [r.describe() for r in rows] and rows[0].from_scheme == "md5"
+    migrator.run(rows)
+    assert rows[0].rewritten > 0
+
+    _assert_migrated(storage, exp.id)
+    # Every non-identity field survived byte-for-byte.
+    after = {
+        dumps_canonical({k: v for k, v in d.items() if k not in ("_id", "parents")})
+        for d in db.read("trials", {"experiment": exp.id})
+    }
+    assert after == before
+    assert not old_ids & {d["_id"] for d in db.read("trials", {"experiment": exp.id})}
+    # Re-running converges to a no-op: nothing left to plan.
+    assert IdMigrator(storage).plan() == []
+
+    # A producer resuming from storage picks up the flipped scheme and
+    # keeps registering NEW trials under cube ids, duplicate-free.
+    exp2 = build_experiment(storage, "mig-exp").instantiate(seed=7)
+    assert exp2.version == exp.version  # resume, not an EVC branch
+    assert exp2.id_scheme == "cube_hash"
+    producer = Producer(exp2)
+    producer.update()
+    assert producer.produce(4) == 4
+    docs = db.read("trials", {"experiment": exp.id})
+    assert len({d["_id"] for d in docs}) == len(docs)
+    space = build_space(dict(PRIORS))
+    assert {d["_id"] for d in docs} == set(
+        compute_scheme_ids(
+            exp.id,
+            [d.get("params") or {} for d in docs],
+            id_scheme="cube_hash",
+            space=space,
+        )
+    )
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+@pytest.mark.parametrize("stage", ["after_copy", "after_verify", "after_flip"])
+def test_crash_resume_converges_from_any_stage(stage):
+    storage = create_storage({"type": "memory"})
+    exp = _seed_experiment(storage)
+
+    def crash(at, exp_id):
+        if at == stage:
+            raise _Crash(at)
+
+    with pytest.raises(_Crash):
+        IdMigrator(storage, crash_at=crash).run()
+    # The interrupted run left a standing migration doc — the resume signal.
+    assert storage.db.read(MIGRATION_COLLECTION, {}) != []
+
+    # A fresh migrator (no local state — plan is recomputed from storage)
+    # carries it to the exact same end state as an uncrashed run.
+    IdMigrator(storage).run()
+    _assert_migrated(storage, exp.id)
+
+
+# --- sharded routing ---------------------------------------------------------
+
+
+def test_sharded_roundtrip_routes_by_experiment():
+    from orion_tpu.core.experiment import experiment_id
+    from orion_tpu.storage.base import DocumentStorage
+    from orion_tpu.storage.netdb import DBServer
+    from orion_tpu.storage.shard import ShardedNetworkDB
+
+    servers = [DBServer(port=0) for _ in range(3)]
+    for server in servers:
+        server.serve_background()
+    spec = [{"host": s.address[0], "port": s.address[1]} for s in servers]
+    router = ShardedNetworkDB(spec, reconnect_jitter=0, timeout=3.0)
+    try:
+        names = [f"mig-shard-{i}" for i in range(4)]
+        exp_ids = {}
+        for name in names:
+            eid = experiment_id(name, 1, "u")
+            exp_ids[name] = eid
+            router.write("experiments", {
+                "_id": eid, "name": name, "version": 1,
+                "priors": dict(PRIORS), "metadata": {"user": "u"},
+            })
+            space = build_space(PRIORS)
+            rows = _rows(space, 4, seed=hash(name) % 1000)
+            old = compute_batch_ids(eid, rows)
+            router.write("trials", [
+                {
+                    "_id": old[i], "experiment": eid, "status": "completed",
+                    "objective": float(i), "params": rows[i],
+                    # Lineage within the batch: the migrator must remap it.
+                    "parents": [old[i - 1]] if i else [],
+                    "results": [
+                        {"name": "obj", "type": "objective",
+                         "value": float(i)}
+                    ],
+                    "submit_time": 1.0, "start_time": 1.0, "end_time": 2.0,
+                    "heartbeat": 2.0,
+                }
+                for i in range(len(rows))
+            ])
+            router.write("lying_trials", [
+                dict(
+                    router.read("trials", {"_id": old[0]})[0],
+                    _id=compute_batch_ids(eid, rows[:1], lie=True)[0],
+                    status="broken",
+                )
+            ])
+
+        storage = DocumentStorage(router)
+        rows = IdMigrator(storage).run()
+        assert len(rows) == len(names)
+
+        space = build_space(PRIORS)
+        for name in names:
+            eid = exp_ids[name]
+            exp_doc = _assert_migrated(storage, eid)
+            # Every doc (the migration doc included, while it existed)
+            # lives on the experiment's home shard: reading THROUGH the
+            # router by experiment key finds the full set.
+            docs = router.read("trials", {"experiment": eid})
+            by_id = {d["_id"]: d for d in docs}
+            expected = compute_scheme_ids(
+                eid, [d.get("params") or {} for d in docs],
+                id_scheme="cube_hash", space=space,
+            )
+            # Parents lineage was remapped old->new in the same pass.
+            for doc in docs:
+                for parent in doc.get("parents") or []:
+                    assert parent in by_id
+            lying = router.read("lying_trials", {"experiment": eid})
+            assert len(lying) == 1
+            assert lying[0]["_id"] == compute_scheme_ids(
+                eid, [lying[0]["params"]], lie=True,
+                id_scheme="cube_hash", space=space,
+            )[0]
+            assert set(expected) == set(by_id)
+        # No migration docs anywhere on any shard.
+        for _index, conn in router.shard_connections():
+            assert conn.read(MIGRATION_COLLECTION, {}) == []
+    finally:
+        router.close()
+        for server in servers:
+            server.shutdown()
+            server.server_close()

@@ -1,0 +1,74 @@
+"""PlanPrepToken: the steady-path dispatch-prep cache (algo/tpu_bo.py).
+
+The token may only ever be a shortcut — a run with the token disabled
+(``algo._prep_token = None`` forces the full prep-key probe every round)
+must produce a bit-identical suggestion stream, because both paths feed
+the SAME ``_finish_plan`` tail.  And the stats it feeds the bench's
+``dispatch_us_saved`` line must count what actually happened: one miss to
+pin a bucket, hits while the bucket holds, a fresh miss when the fast key
+changes (q bucket, cold→warm flip).
+"""
+
+from orion_tpu.algo.base import create_algo
+from orion_tpu.algo.tpu_bo import (
+    dispatch_prep_stats,
+    reset_dispatch_prep_stats,
+)
+from orion_tpu.space.dsl import build_space
+
+CFG = {"tpu_bo": {"n_init": 4, "n_candidates": 64, "fit_steps": 2,
+                   "refit_steps": 1}}
+PRIORS = {"a": "uniform(0, 1)", "b": "uniform(0, 1)", "c": "uniform(0, 1)"}
+SEED_POINTS = [
+    {"a": 0.1, "b": 0.2, "c": 0.3},
+    {"a": 0.7, "b": 0.1, "c": 0.9},
+    {"a": 0.4, "b": 0.8, "c": 0.2},
+    {"a": 0.9, "b": 0.5, "c": 0.6},
+]
+
+
+def _warm_algo(token=True):
+    space = build_space(PRIORS)
+    algo = create_algo(space, CFG, seed=0)
+    if not token:
+        algo._prep_token = None
+    algo.observe(
+        SEED_POINTS,
+        [{"objective": p["a"] + p["b"]} for p in SEED_POINTS],
+    )
+    return algo
+
+
+def test_token_fast_path_is_bit_identical_to_full_probe():
+    fast = _warm_algo(token=True)
+    slow = _warm_algo(token=False)
+    assert slow._prep_token is None
+    for round_ in range(4):
+        q = 16 if round_ == 2 else 4  # bucket change mid-stream too
+        got = fast.suggest(q)
+        want = slow.suggest(q)
+        assert got == want, f"streams diverged at round {round_}"
+        outcomes = [{"objective": sum(p.values())} for p in got]
+        fast.observe(got, outcomes)
+        slow.observe(want, outcomes)
+    assert fast._prep_token.pinned is not None  # the fast path was live
+
+
+def test_dispatch_prep_stats_count_pin_hold_and_rekey():
+    algo = _warm_algo(token=True)
+    reset_dispatch_prep_stats()
+    algo.suggest(4)  # cold fit: miss, pins (bucket 8, warm_is_none=True)
+    algo.suggest(4)  # warm now — fast key flipped: miss, re-pins
+    algo.suggest(4)  # steady path
+    algo.suggest(4)
+    stats = dispatch_prep_stats()
+    assert stats["misses"] == 2
+    assert stats["hits"] == 2
+    algo.suggest(16)  # q bucket 8 -> 16: the token must not lie
+    stats = dispatch_prep_stats()
+    assert stats["misses"] == 3
+    assert stats["saved_us"] >= 0.0
+    # The breakdown line's inputs are all present and well-formed.
+    assert set(stats) == {
+        "hits", "misses", "hit_us_mean", "miss_us_mean", "saved_us"
+    }

@@ -174,6 +174,86 @@ def test_gateway_streams_bit_identical_to_standalone(gateway):
     assert stats["dispatches_per_suggest"] < 1.0
 
 
+#: asha_bo leg of the differential: a fidelity dimension, rung promotions
+#: riding ahead of the GP plan, and the promotion-stash demux on the
+#: gateway side.  n_init == q == 8 makes round 1 random init and every
+#: later round promote 8//3 = 2 — so GP rounds carry a stash AND fresh
+#: points, the exact shape the coalescer must keep bit-stable.
+ASHA_PRIORS = {
+    **{f"x{i}": "uniform(0, 1)" for i in range(3)},
+    "epochs": "fidelity(1, 9, 3)",
+}
+ASHA_CFG = {"asha_bo": {"n_init": 8, "n_candidates": 64, "fit_steps": 4}}
+ASHA_Q = 8
+
+
+def _drive_asha(algo, rounds, barrier=None):
+    streams = []
+    for _ in range(rounds):
+        if barrier is not None:
+            barrier.wait(timeout=60)
+        params = algo.suggest(ASHA_Q)
+        streams.append(params)
+        algo.observe(
+            params,
+            [
+                {"objective": _objective(
+                    {k: v for k, v in p.items() if k.startswith("x")}
+                )}
+                for p in params
+            ],
+        )
+    return streams
+
+
+def test_asha_bo_served_streams_bit_identical_and_coalesce(gateway):
+    """Two asha_bo tenants through one gateway == standalone, with rung
+    promotions crossing the wire, and their GP rounds still coalescing
+    (width >= 2) — promotions ride the reply, never a separate dispatch."""
+    rounds, seeds = 3, (0, 1)
+    reference = {
+        seed: _drive_asha(
+            create_algo(build_space(ASHA_PRIORS), ASHA_CFG, seed=seed), rounds
+        )
+        for seed in seeds
+    }
+    # Promotions actually happened standalone — the differential is not
+    # vacuously comparing pure-init streams.
+    fidelities = {
+        p["epochs"] for stream in reference.values() for r in stream for p in r
+    }
+    assert len(fidelities) > 1, "no rung promotions in the reference run"
+
+    barrier = threading.Barrier(len(seeds))
+    out, errors = {}, []
+
+    def worker(seed):
+        try:
+            host, port = gateway.address
+            remote = RemoteAlgorithm(
+                build_space(ASHA_PRIORS), ASHA_PRIORS, ASHA_CFG,
+                GatewayClient(host=host, port=port),
+                f"asha-diff-{seed}", seed=seed,
+            )
+            out[seed] = _drive_asha(remote, rounds, barrier)
+        except Exception as exc:  # surfaced after join
+            errors.append(exc)
+            barrier.abort()
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in seeds]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=300)
+    assert not errors, errors
+    for seed in seeds:
+        assert out[seed] == reference[seed], (
+            f"served asha_bo stream diverged from standalone for seed {seed}"
+        )
+    stats = gateway.stats_snapshot()
+    assert stats["max_width"] >= 2, stats["widths"]
+
+
 def test_naive_suggest_mirrors_producer_semantics(gateway):
     """The producer's naive-clone round through the gateway == the same
     sequence run locally: deepcopy, observe lies on the copy, suggest from

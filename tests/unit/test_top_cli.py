@@ -293,3 +293,79 @@ def test_info_all_prints_every_experiment(tmp_path, capsys):
     # Health section (with the per-worker records) rides along for the
     # experiment that recorded health.
     assert "health records: 6 from 2 worker(s)" in out
+
+
+def test_host_device_ratio_column_and_breach_line(tmp_path, capsys, monkeypatch):
+    """The h/d column is mean producer.round / mean device.dispatch per
+    worker, flagged against the orion_tpu.hostbudget bar (the SAME knob
+    as the bench gate and doctor DX004); `info` prints the merged ratio
+    line.  A worker with no device histogram degrades to '-'."""
+    from orion_tpu.cli import main as cli_main
+    from orion_tpu.cli.top import _host_device_ratio
+    from orion_tpu.hostbudget import ENV_VAR
+
+    monkeypatch.delenv(ENV_VAR, raising=False)
+
+    def hist(count, mean_s):
+        buckets = [0] * 48
+        buckets[20] = count
+        return {"buckets": buckets, "count": count, "sum": mean_s * count,
+                "min": mean_s, "max": mean_s}
+
+    assert _host_device_ratio({
+        "producer.round": hist(10, 0.004), "device.dispatch": hist(10, 0.002),
+    }) == 2.0
+    assert _host_device_ratio({"producer.round": hist(10, 0.004)}) is None
+    assert _host_device_ratio({}) is None
+
+    db_path = str(tmp_path / "ratio.sqlite")
+    storage = create_storage({"type": "sqlite", "path": db_path})
+    exp = storage.create_experiment({"name": "hd", "metadata": {"user": "u"}})
+    for worker, round_mean in (("ok:1", 0.002), ("slow:2", 0.010)):
+        storage.record_metrics(
+            exp,
+            {"counters": {}, "gauges": {}, "histograms": {
+                "producer.round": hist(10, round_mean),
+                "device.dispatch": hist(10, 0.001),
+            }},
+            worker=worker,
+        )
+    storage.record_metrics(
+        exp,
+        {"counters": {}, "gauges": {}, "histograms": {}},
+        worker="fresh:3",  # no histograms yet: the column shows '-'
+    )
+
+    class _Exp:
+        def __init__(self):
+            self.storage = storage
+            self.name = "hd"
+            self.version = 1
+            self.id = exp["_id"]
+
+    snap = snapshot_top(_Exp())
+    assert snap["workers"]["ok:1"]["host_device_ratio"] == 2.0
+    assert snap["workers"]["slow:2"]["host_device_ratio"] == 10.0
+    assert snap["workers"]["fresh:3"]["host_device_ratio"] is None
+
+    frame = render_top(snap)
+    assert " h/d" in frame  # the column exists
+    # 2.0 < 2.25 budget: no marker; 10.0: flagged and named in the footer.
+    assert "10.00!" in frame and "2.00!" not in frame
+    assert "HOST-BUDGET BREACH (round > 2.25x device window): slow:2" in frame
+
+    # Tighten the knob: the quiet worker breaches too — same env override
+    # everywhere.
+    monkeypatch.setenv(ENV_VAR, "0.5")
+    frame = render_top(snap)
+    assert "2.00!" in frame
+    assert "HOST-BUDGET BREACH (round > 1.5x device window): ok:1, slow:2" in frame
+    monkeypatch.delenv(ENV_VAR, raising=False)
+
+    # `info` prints the merged-histogram ratio against the same bar.
+    rc = cli_main(["info", "-n", "hd", "--storage-path", db_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "host/device ratio:" in out
+    assert "(budget 2.25x)" in out
+    assert "HOST-BUDGET BREACH" in out  # merged means include slow:2's tail
