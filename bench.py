@@ -922,6 +922,7 @@ def _json_payload(
     prewarm=None,
     health=None,
     regret_gate=None,
+    compiler=None,
     smoke=False,
 ):
     """THE output schema — built here for both the full run and --smoke, so
@@ -980,6 +981,11 @@ def _json_payload(
         # Multi-seed regret-trajectory gate verdict
         # (orion_tpu.benchmarks.regret_gate vs BENCH_REGRET_BASELINE.json).
         "regret_gate": regret_gate,
+        # Compiler-plane digest (orion_tpu.compiler_plane, compiler_block):
+        # every XLA compile this run paid with per-plan compile_ms / flops /
+        # hbm_bytes / predicted HBM-bound q, and the retrace-attribution
+        # totals the smoke gate pins (retraces_attributed == retraces).
+        "compiler": compiler,
         # Distributed-trace critical-path split of the traced producer
         # rounds (orion_tpu.tracing, mean ms per round): client-host /
         # wire / server-host / device — stamped by _safe_trace.
@@ -1001,6 +1007,7 @@ def bench_history_record(payload, now=None):
     trend rules (and humans) join across runs, without the multi-KB curve
     and trace blocks."""
     gate = payload.get("regret_gate") or {}
+    compiler = payload.get("compiler") or {}
     return {
         "schema_version": payload.get("schema_version"),
         "time": time.time() if now is None else now,
@@ -1014,6 +1021,14 @@ def bench_history_record(payload, now=None):
         "storage_ms": payload.get("storage_ms"),
         "regret_gate_pass": gate.get("pass"),
         "doctor_critical": payload.get("doctor_critical"),
+        # Compiler-plane columns (orion_tpu.compiler_plane): total compile
+        # wall ms, attribution coverage, and the worst plan's HBM footprint
+        # — the trend the DX050/DX053 doctor rules will join across runs.
+        # Present even when None (a backend without memory_analysis): the
+        # smoke hook checks PRESENCE, the attribution gate checks equality.
+        "compile_ms_total": compiler.get("compile_ms_total"),
+        "retraces_attributed": compiler.get("retraces_attributed"),
+        "plan_hbm_bytes_max": compiler.get("plan_hbm_bytes_max"),
     }
 
 
@@ -1042,6 +1057,49 @@ def append_bench_history(payload, path=None):
     except OSError:
         return None
     return path
+
+
+def compiler_block(families=("fused_plan", "stacked"), limit=8):
+    """The compiler-plane digest of THIS bench run (orion_tpu
+    .compiler_plane): run the pending cost/memory analyses — each an AOT
+    ``lower().compile()``, which is exactly why this only happens here, on
+    the bench's declared cold path, bounded by ``limit`` with the skipped
+    count reported — then return the registry summary with per-plan
+    compile_ms / flops / hbm_bytes and the predicted HBM-bound q.  The
+    ``retraces``/``retraces_attributed`` totals come from the PROCESS
+    telemetry counters, not the registry's own bookkeeping (which is equal
+    by construction): the gate's point is catching a jit call site that
+    counts ``jax.retraces`` without going through the registry."""
+    from orion_tpu import telemetry as tel
+    from orion_tpu.compiler_plane import COMPILE_REGISTRY
+
+    analysis = COMPILE_REGISTRY.analyze_all(families=families, limit=limit)
+    summary = COMPILE_REGISTRY.summary()
+    summary["analysis"] = analysis
+    summary["retraces"] = int(tel.TELEMETRY.counter_value("jax.retraces"))
+    summary["retraces_attributed"] = int(
+        tel.TELEMETRY.counter_value("jax.retraces.attributed")
+    )
+    summary["retraces_prewarm_covered"] = int(
+        tel.TELEMETRY.counter_value("jax.retraces.prewarm_covered")
+    )
+    return summary
+
+
+def _check_retrace_attribution(compiler):
+    """Every post-warm retrace must be attributed: a ``jax.retraces``
+    sample without a ``CompileRegistry.record_retrace`` twin means some
+    jit call site books stalls the flight `jax.retrace` event cannot
+    explain — the self-diagnosing contract of the compiler plane."""
+    retraces = compiler.get("retraces") or 0
+    attributed = compiler.get("retraces_attributed") or 0
+    if retraces != attributed:
+        # Not an assert: the gate must hold under `python -O` too.
+        raise SystemExit(
+            f"retrace attribution gate failed: {retraces} jax.retraces vs "
+            f"{attributed} attributed — a jit call site counts retraces "
+            "outside the CompileRegistry (doctor rule DX051)"
+        )
 
 
 def doctor_gate(health_records, hard=False):
@@ -1131,6 +1189,8 @@ def main(smoke=False, trace_out="bench_trace.json"):
         f"anchor={anchor_regret:.6f} tol={REGRET_TOL}"
     )
     trace_file, host_attribution = _safe_trace(trace_out)
+    compiler = compiler_block()
+    _check_retrace_attribution(compiler)
     payload = _json_payload(
         metric=(
             "suggestions/sec @ q=1024, Hartmann6 "
@@ -1148,6 +1208,7 @@ def main(smoke=False, trace_out="bench_trace.json"):
         prewarm=prewarm,
         health=_health_payload(curves[GATE_SEEDS.index(SEED)], health_records),
         regret_gate=gate,
+        compiler=compiler,
     )
     payload["trace_file"] = trace_file
     payload["host_attribution"] = host_attribution
@@ -1823,6 +1884,12 @@ def main_smoke(trace_out="bench_trace.json"):
     # wall is the full stage sum — so the appended history record carries
     # real host/device/storage columns even for smoke runs, keeping the
     # host/device ratio trendable across the whole series.
+    # Compiler-plane digest + hard attribution gate: every jax.retraces
+    # sample this run counted must have a CompileRegistry attribution twin
+    # (the analyze pass is the bench's declared cold path for the AOT
+    # second compiles).
+    compiler = compiler_block()
+    _check_retrace_attribution(compiler)
     smoke_device_ms = round(breakdown["wait_transfer"], 3)
     smoke_wall_ms = round(
         sum(
@@ -1850,6 +1917,7 @@ def main_smoke(trace_out="bench_trace.json"):
         prewarm=prewarm,
         health=_health_payload(curve, health_records),
         regret_gate=gate,
+        compiler=compiler,
         smoke=True,
     )
     payload["trace_file"] = trace_file
@@ -1880,6 +1948,16 @@ def main_smoke(trace_out="bench_trace.json"):
     missing = [
         k for k in ("host_ms_per_round", "device_ms_per_round", "storage_ms")
         if not record.get(k)
+    ]
+    # Compiler-plane columns: PRESENCE check (`in`), not truthiness — a
+    # backend without memory_analysis legitimately reports None for the
+    # HBM column, but the key itself going missing is schema drift.
+    missing += [
+        k
+        for k in (
+            "compile_ms_total", "retraces_attributed", "plan_hbm_bytes_max"
+        )
+        if k not in record
     ]
     if missing:
         # Not an assert: the gate must hold under `python -O` too.

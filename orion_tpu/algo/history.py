@@ -35,6 +35,7 @@ append.
 """
 
 import threading
+import time
 import weakref
 from functools import partial
 
@@ -42,6 +43,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from orion_tpu.compiler_plane import (
+    COMPILE_REGISTRY,
+    jit_cache_size,
+    signature_fields,
+)
 from orion_tpu.telemetry import TELEMETRY
 
 
@@ -261,6 +267,18 @@ class DeviceHistory:
         TELEMETRY.count(
             "history.appends.donated" if donated else "history.appends.copied"
         )
+        # Compiler-plane bracket: the append twins compile once per
+        # (capacity bucket, batch bucket, donation mode) — cache growth
+        # during the call books a plain `append`-family compile.  NOT a
+        # retrace: bucket crossings legitimately compile a fresh append jit
+        # (no prewarm covers it by design — the compile is milliseconds,
+        # far under the fused step's), and counting it as `jax.retraces`
+        # would fail the bench's retraces_after_warm == 0 gate for a stall
+        # the suggest path never paid.
+        tel_t0 = tel_before = None
+        if TELEMETRY.enabled:
+            tel_before = jit_cache_size(fn)
+            tel_t0 = time.perf_counter()
         self._x, self._y, self._mask = fn(
             self._x,
             self._y,
@@ -270,6 +288,17 @@ class DeviceHistory:
             jnp.asarray(mvals),
             jnp.int32(self.count),
         )
+        if tel_t0 is not None:
+            after = jit_cache_size(fn)
+            if tel_before is not None and after is not None and after > tel_before:
+                COMPILE_REGISTRY.record_compile(
+                    "append",
+                    signature_fields(
+                        (self.cap, self.n_cols),
+                        {"donated": donated, "batch": b_pad},
+                    ),
+                    seconds=time.perf_counter() - tel_t0,
+                )
         self._cow = False
         self.count += b
 

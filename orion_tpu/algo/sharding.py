@@ -190,6 +190,21 @@ def mesh_utilization(mesh, *arrays):
     return (min(per), max(per)) if per else (0.0, 0.0)
 
 
+def mesh_fingerprint(mesh):
+    """Compact, stable identity of a mesh for compiler-plane signature
+    fields and span args — ``"4×candidates"`` instead of the multi-line
+    ``str(Mesh)`` (signatures are diffed and rendered in tables; a
+    verbose mesh repr would drown the one static that actually changed).
+    None-safe: an unmeshed dispatch fingerprints as ``"none"``."""
+    if mesh is None:
+        return "none"
+    try:
+        axes = ",".join(str(name) for name in mesh.axis_names)
+        return f"{int(mesh.devices.size)}×{axes}"
+    except Exception:  # hostile/mock mesh — degrade to the repr
+        return str(mesh)
+
+
 def mesh_health_fields(mesh, *arrays):
     """Host-side health-record fields describing the mesh and, when sample
     arrays are given, the measured per-device placement (`serve_width`-style:

@@ -21,7 +21,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from orion_tpu.health import FLIGHT
+from orion_tpu.compiler_plane import (
+    COMPILE_REGISTRY,
+    fields_from_plan_signature,
+    lowered_analysis_fn,
+    signature_fields,
+)
 from orion_tpu.telemetry import TELEMETRY
 
 from orion_tpu.algo.base import BaseAlgorithm, algo_registry
@@ -846,12 +851,28 @@ def start_bucket_prewarm(prewarmer, target_m, width, q_bucket, step_kw, *,
         fixed_tail_cols,
         tuple(sorted((k, str(v)) for k, v in kw.items())),
     )
-    return prewarmer.maybe_start(
-        key,
-        lambda: prewarm_suggest_step(
+
+    def compile_and_record():
+        t0 = time.perf_counter()
+        prewarm_suggest_step(
             target_m, width, q_bucket, fixed_tail_cols=fixed_tail_cols, **kw
-        ),
-    )
+        )
+        if TELEMETRY.enabled:
+            # Compiler plane: record the EXACT signature this warm covers
+            # (built from the same statics `make_fused_plan` hashes into
+            # the plan signature, split-fit adjustment included) — a later
+            # retrace at this signature is a prewarm bug (DX052).
+            statics = dict(kw, q=q_bucket, fixed_tail_cols=fixed_tail_cols)
+            mesh = statics.get("mesh")
+            if mesh is not None and mesh.devices.size > 1:
+                statics["fit_steps"] = 0
+            COMPILE_REGISTRY.record_prewarm(
+                "fused_plan",
+                signature_fields((target_m, width), statics),
+                seconds=time.perf_counter() - t0,
+            )
+
+    return prewarmer.maybe_start(key, compile_and_record)
 
 
 def prewarm_suggest_step(
@@ -1446,14 +1467,21 @@ def run_fused_plan(plan, prewarmer=None):
         )
         if retraced:
             TELEMETRY.count("jax.retraces")
-            # A synchronous retrace is exactly the kind of stall a crash
-            # post-mortem wants on its timeline — book it in the flight
-            # ring too (guarded: the args dict must not allocate when the
-            # recorder is off).
-            if FLIGHT.enabled:
-                FLIGHT.record(
-                    "jax.retrace", args={"q": int(num), "n": int(x.shape[0])}
-                )
+            # Compiler-plane attribution (orion_tpu.compiler_plane): the
+            # registry diffs this signature against the nearest prior one
+            # in the fused_plan family, emits the flight `jax.retrace`
+            # event naming the changed statics (the timeline entry a crash
+            # post-mortem wants), and keeps a lazy cost/memory closure —
+            # shape specs only, never the arrays — for cold-path analysis
+            # (bench's compiler block, `orion-tpu profile`).
+            COMPILE_REGISTRY.record_retrace(
+                "fused_plan",
+                fields_from_plan_signature(plan.signature),
+                seconds=time.perf_counter() - tel_t0,
+                analysis_fn=lowered_analysis_fn(
+                    _suggest_step, plan.arrays, plan.statics
+                ),
+            )
     # Dedup ordered unique draws first, so the first `num` rows are the ones
     # the un-padded call would have returned.  Rows come back as a DEVICE
     # array slice: jax dispatch is asynchronous, so callers that defer the

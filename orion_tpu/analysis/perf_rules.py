@@ -1,4 +1,4 @@
-"""Hot-path vectorization rules (``PERF001``, ``PERF002``).
+"""Hot-path vectorization rules (``PERF001``, ``PERF002``, ``PERF003``).
 
 ISSUE 13 burned the per-trial python work out of the steady-state producer
 round: the cube<->params codec runs one numpy/lookup-table pass per
@@ -34,6 +34,16 @@ a cache: lexically guarded by a conditional on a value loaded from a
 the exemplars).  Per-round ARRAY tuples (the donated device operands) are
 not rebuild products — they change every round by definition — so the
 rule keys on the declared product names, not on every tuple literal.
+
+``PERF003`` pins the compiler plane's cost discipline (ISSUE 18):
+``cost_analysis()`` / ``memory_analysis()`` synchronize on the compiled
+executable, and the AOT ``.lower(...).compile()`` chain is a SECOND full
+XLA compile — both are fine in a bench or a deliberate registry sweep,
+and ruinous anywhere a production round can reach.  The registry module
+(``compiler_plane.py``) is the one declared cold path that owns these
+calls; everything else must route through ``CompileRegistry.analyze_all``
+/ ``lowered_analysis_fn`` or carry a suppression-with-reason naming why
+its call site is cold.
 """
 
 import ast
@@ -338,4 +348,80 @@ class UncachedPrepRebuild(Rule):
                                           where, path)
 
 
-PERF_RULES = (PerTrialLoopInHotPath, UncachedPrepRebuild)
+#: The one module that OWNS compiler introspection: the CompileRegistry's
+#: own lazy ``analyze_all`` path.  By basename so worktrees/venv layouts
+#: don't matter; everything else is a hot path until argued otherwise.
+COLD_COMPILER_MODULES = frozenset({"compiler_plane.py"})
+
+#: Compiled-executable methods that synchronize and allocate host-side.
+_INTROSPECTION_ATTRS = frozenset({"cost_analysis", "memory_analysis"})
+
+
+class CompilerIntrospectionOutsideColdPath(Rule):
+    id = "PERF003"
+    name = "compiler-introspection-outside-cold-path"
+    description = (
+        "cost_analysis()/memory_analysis() or a chained .lower(...)"
+        ".compile() outside the declared compiler-plane cold path; these "
+        "synchronize on the executable (and the AOT chain is a second full "
+        "XLA compile) — route through CompileRegistry.analyze_all / "
+        "lowered_analysis_fn, or suppress with the reason the call site "
+        "is cold"
+    )
+
+    @staticmethod
+    def _basename(path):
+        return str(path).replace("\\", "/").rsplit("/", 1)[-1]
+
+    @staticmethod
+    def _is_aot_chain(node):
+        """``<expr>.lower(...).compile(...)`` — a Call on an Attribute
+        named ``compile`` whose value is itself a Call on an Attribute
+        named ``lower``."""
+        return (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "compile"
+            and isinstance(node.func.value, ast.Call)
+            and isinstance(node.func.value.func, ast.Attribute)
+            and node.func.value.func.attr == "lower"
+        )
+
+    def check(self, module):
+        if self._basename(module.path) in COLD_COMPILER_MODULES:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _INTROSPECTION_ATTRS
+            ):
+                yield Diagnostic(
+                    module.path,
+                    node.lineno,
+                    node.col_offset,
+                    self.id,
+                    f"'{node.func.attr}()' synchronizes on the compiled "
+                    "executable outside the compiler-plane cold path; read "
+                    "it from CompileRegistry entries (analyze_all / "
+                    "lowered_analysis_fn) or suppress with the reason this "
+                    "call site is cold",
+                )
+            elif self._is_aot_chain(node):
+                yield Diagnostic(
+                    module.path,
+                    node.lineno,
+                    node.col_offset,
+                    self.id,
+                    "chained '.lower(...).compile()' is a second full XLA "
+                    "compile outside the compiler-plane cold path; use "
+                    "lowered_analysis_fn or suppress with the reason this "
+                    "call site is cold",
+                )
+
+
+PERF_RULES = (
+    PerTrialLoopInHotPath,
+    UncachedPrepRebuild,
+    CompilerIntrospectionOutsideColdPath,
+)

@@ -18,8 +18,10 @@ shapes:
 - ``public_ms``   — one round through the public ``algo.suggest`` API
   (adds host-side copula transform, codec decode, param-dict construction).
 
-FLOPs come from XLA's own cost model on the compiled executable
-(``compiled.cost_analysis()["flops"]``), not hand arithmetic; achieved
+FLOPs come from XLA's own cost model on the compiled executable via the
+compiler plane's shared analysis path (``orion_tpu.compiler_plane`` — the
+same ``lower().compile()`` + cost/memory extraction the CompileRegistry
+runs for the runtime), not hand arithmetic; achieved
 FLOP/s = flops / device_s, and MFU is quoted against the TPU v5e bf16 peak
 (1.97e14 FLOP/s — "How to Scale Your Model" hardware table; the GP path
 runs f32, whose MXU peak is lower, so the bf16-denominated MFU is a strict
@@ -39,6 +41,11 @@ import numpy as np
 
 from orion_tpu.algo.gp.gp import init_hypers
 from orion_tpu.algo.tpu_bo import _suggest_step
+from orion_tpu.compiler_plane import (
+    device_hbm_capacity,
+    lowered_analysis_fn,
+    predict_hbm_bound_q,
+)
 
 V5E_PEAK_FLOPS = 1.97e14  # bf16; see module docstring
 
@@ -154,14 +161,6 @@ def _time_fn(fn, args, reps=8, warmup=2):
     return best
 
 
-def _xla_flops(args, step_kw):
-    compiled = _suggest_step.lower(*args, **step_kw).compile()
-    ca = compiled.cost_analysis()
-    if isinstance(ca, (list, tuple)):  # one entry per device on some jax versions
-        ca = ca[0]
-    return float(ca.get("flops", float("nan"))) if ca else float("nan")
-
-
 def _public_round_ms(name, cfg, reps=5):
     """One observe+suggest round through the public algorithm API at the
     same steady-state shape (hartmann6's is bench.py's timed loop)."""
@@ -218,7 +217,13 @@ def run_suggest_bench(reps=8, shapes=None, kernel="matern52"):
             continue
         step_kw = _step_kwargs(cfg, kernel=kernel)
         args = _make_args(cfg, rng)
-        flops = _xla_flops(args, step_kw)
+        # The compiler plane's shared analysis closure (the exact code path
+        # CompileRegistry.analyze_all runs for the runtime) — a bench IS a
+        # declared cold path, so the AOT second compile is fine here.
+        analysis = lowered_analysis_fn(_suggest_step, args, step_kw)() or {}
+        flops = analysis.get("flops")
+        flops = float("nan") if flops is None else flops
+        hbm_bytes = analysis.get("hbm_bytes")
 
         one = jax.jit(partial(_suggest_step, **step_kw))
         wall_s = _time_fn(lambda *a: one(*a)[0], args, reps=reps)
@@ -239,6 +244,12 @@ def run_suggest_bench(reps=8, shapes=None, kernel="matern52"):
             "gflops_per_call": round(flops / 1e9, 3),
             "achieved_tflops": round(achieved / 1e12, 3),
             "mfu_vs_bf16_peak": round(achieved / V5E_PEAK_FLOPS, 5),
+            # Per-plan HBM footprint + predicted HBM-bound q (ROADMAP item
+            # 1's open tail) — from the same analysis pass as the FLOPs.
+            "plan_hbm_bytes": hbm_bytes,
+            "hbm_bound_q": predict_hbm_bound_q(
+                {"q": cfg["q"]}, hbm_bytes, device_hbm_capacity()
+            ),
             "backend": jax.devices()[0].platform,
         }
         rows.append(row)

@@ -108,12 +108,16 @@ def _spawn_workers(args, experiment):
 
 
 def _run_worker(experiment, parser, args):
-    profile_dir = getattr(args, "profile", None)
-    if profile_dir:
-        import jax
+    from contextlib import nullcontext
 
-        jax.profiler.start_trace(profile_dir)
-    try:
+    from orion_tpu.compiler_plane import profiler_capture
+
+    profile_dir = getattr(args, "profile", None)
+    # The shared capture helper: `orion-tpu profile --capture DIR` wraps its
+    # analysis pass in the same context manager, so both commands print the
+    # identical artifact summary line.
+    capture = profiler_capture(profile_dir) if profile_dir else nullcontext()
+    with capture:
         workon(
             experiment,
             parser,
@@ -123,12 +127,6 @@ def _run_worker(experiment, parser, args):
             # trials get recovered as lost.
             heartbeat_interval=experiment.heartbeat / 2.0,
         )
-    finally:
-        if profile_dir:
-            import jax
-
-            jax.profiler.stop_trace()
-            print(f"jax profiler trace written to {profile_dir}", file=sys.stderr)
 
 
 def main(args):

@@ -99,6 +99,11 @@ def format_info(experiment, per_worker=False):
         out.append(_section("Telemetry"))
         out.extend(tele)
 
+    compiler = _compiler_section(metrics_docs)
+    if compiler:
+        out.append(_section("Compiler"))
+        out.extend(compiler)
+
     health = _health_section(experiment, per_worker=per_worker, docs=health_docs)
     if health:
         out.append(_section("Health"))
@@ -170,6 +175,43 @@ def _perf_section(experiment):
             f"p99 {pct(99) * 1e3:.1f}ms  max {durations[-1] * 1e3:.1f}ms"
         )
     return lines
+
+
+def _compiler_section(docs):
+    """The compiler-plane digest (orion_tpu.compiler_plane): total XLA
+    compiles and retrace-attribution coverage from the merged counters,
+    plus the HBM-headroom line `orion-tpu profile` and `top` also render.
+    Empty unless some worker recorded compiles; guarded like the telemetry
+    block."""
+    if not docs:
+        return []
+    try:
+        from orion_tpu.cli.profile import hbm_line
+        from orion_tpu.telemetry import merge_snapshots
+
+        merged = merge_snapshots(docs)
+        counters = merged.get("counters") or {}
+        gauges = merged.get("gauges") or {}
+        compiles = counters.get("jax.compiles")
+        if not compiles:
+            return []
+        lines = [
+            f"compiles: {int(compiles)}  "
+            f"retraces: {int(counters.get('jax.retraces', 0))} "
+            f"({int(counters.get('jax.retraces.attributed', 0))} attributed, "
+            f"{int(counters.get('jax.retraces.prewarm_covered', 0))} "
+            "prewarm-covered)"
+        ]
+        ms_total = gauges.get("compiler.compile_ms_total")
+        if ms_total:
+            lines.append(f"compile time total: {float(ms_total):.1f}ms")
+        headroom = hbm_line(gauges)
+        if headroom:
+            lines.append(headroom)
+        lines.append("details: `orion-tpu profile -n NAME`")
+        return lines
+    except Exception:
+        return []
 
 
 def _snapshot_lines(snapshot):

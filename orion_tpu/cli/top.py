@@ -163,6 +163,9 @@ def snapshot_top(experiment, now=None):
             "gave_up": int(counters.get("storage.gave_up", 0)),
             "reconnects": _counter_sum(counters, ".reconnects") or 0,
             "retraces": int(counters.get("jax.retraces", 0)),
+            # Compiler plane (orion_tpu.compiler_plane): every XLA compile
+            # this worker paid — retraces, prewarms, and append-jit forks.
+            "compiles": int(counters.get("jax.compiles", 0)),
             # Device-memory accounting (orion_tpu.devmem): live device
             # buffer MB, falling back to the resident-history gauge when
             # live_arrays introspection was unavailable on the worker.
@@ -206,6 +209,7 @@ def snapshot_top(experiment, now=None):
                 "gave_up": 0,
                 "reconnects": 0,
                 "retraces": 0,
+                "compiles": 0,
                 "mem_mb": None,
                 "host_device_ratio": None,
                 "last_seen_s": None,
@@ -272,6 +276,14 @@ def snapshot_top(experiment, now=None):
     # the dashboard leads with the verdict, not just the raw numbers.
     doctor = _doctor_block(experiment, metrics_docs, health_docs, now)
 
+    # Compiler-plane gauges, MAX-merged across workers (the headroom line
+    # cares about the worst plan anywhere in the fleet).
+    compiler = {}
+    for doc in metrics_docs:
+        for key, value in (doc.get("gauges") or {}).items():
+            if key.startswith("compiler."):
+                compiler[key] = max(compiler.get(key, 0.0), float(value))
+
     return {
         "experiment": experiment.name,
         "version": experiment.version,
@@ -285,6 +297,7 @@ def snapshot_top(experiment, now=None):
         "regret_curve": curve,
         "health_records": len(health_docs),
         "doctor": doctor,
+        "compiler": compiler,
     }
 
 
@@ -362,8 +375,8 @@ def render_top(snap):
     budget = round_budget_factor()
     header = (
         f"{'worker':<24} {'rounds':>6} {'rate/s':>7} {'age':>7} {'hb lag':>7} "
-        f"{'sto p99':>8} {'mem MB':>8} {'h/d':>6} {'retry':>5} {'reconn':>6} "
-        f"{'best_y':>12} {'gp_mll':>8} {'tr_len':>6}"
+        f"{'sto p99':>8} {'mem MB':>8} {'h/d':>6} {'cmpl':>5} {'retry':>5} "
+        f"{'reconn':>6} {'best_y':>12} {'gp_mll':>8} {'tr_len':>6}"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -396,6 +409,7 @@ def render_top(snap):
             f"{fmt(row['storage_p99_ms'], '7.1f'):>8} "
             f"{fmt(row.get('mem_mb'), '8.1f'):>8} "
             f"{ratio_cell:>6} "
+            f"{row.get('compiles', 0):>5} "
             f"{row['retries']:>5} {row['reconnects']:>6} "
             f"{fmt(health.get('best_y'), '12.5g'):>12} "
             f"{fmt(health.get('gp_mll'), '8.3f'):>8} "
@@ -411,6 +425,13 @@ def render_top(snap):
             f"HOST-BUDGET BREACH (round > {budget:g}x device window): "
             + ", ".join(over_budget)
         )
+    # HBM-headroom line from the MAX-merged compiler.* gauges — the same
+    # rendering `orion-tpu profile` leads with (one code path, DX053's bar).
+    from orion_tpu.cli.profile import hbm_line
+
+    headroom = hbm_line(snap.get("compiler") or {})
+    if headroom:
+        lines.append(headroom)
     return "\n".join(lines)
 
 

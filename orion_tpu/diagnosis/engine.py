@@ -24,7 +24,7 @@ Contracts every rule keeps (lint rule ``TEL006`` machine-checks them):
 
 Rule ids live in the ``DX*`` family: ``DX0xx`` systems (``rules_system``),
 ``DX02x`` storage/replication (``rules_storage``), ``DX04x`` optimizer
-health (``rules_gp``).
+health (``rules_gp``), ``DX05x`` compiler plane (``rules_compiler``).
 """
 
 import json
@@ -126,12 +126,13 @@ def default_rules():
     severity or runbook anchor is missing would ship findings the report
     cannot rank or the operator cannot act on — refuse at registration,
     exactly where the TEL006 lint rule points."""
+    from orion_tpu.diagnosis.rules_compiler import COMPILER_RULES
     from orion_tpu.diagnosis.rules_gp import GP_RULES
     from orion_tpu.diagnosis.rules_storage import STORAGE_RULES
     from orion_tpu.diagnosis.rules_system import SYSTEM_RULES
 
     rules = []
-    for family in (SYSTEM_RULES, STORAGE_RULES, GP_RULES):
+    for family in (SYSTEM_RULES, STORAGE_RULES, GP_RULES, COMPILER_RULES):
         for cls in family:
             if cls.severity not in SEVERITIES:
                 raise ValueError(

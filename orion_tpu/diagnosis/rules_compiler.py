@@ -1,0 +1,142 @@
+"""Compiler-plane doctor rules (``DX05x``): compile-storm rate, retrace
+attribution coverage, prewarm correctness, and HBM headroom.
+
+These read the signals :mod:`orion_tpu.compiler_plane` emits — the
+``jax.compiles`` counter, the ``jax.retraces.attributed`` /
+``jax.retraces.prewarm_covered`` attribution counters, and the
+``compiler.*`` gauges /metrics publishes — so every rule is gated on the
+compiler plane actually being active (``jax.compiles > 0`` where it
+matters): an old snapshot from a build without the plane must stay quiet,
+not fire "unattributed" over counters that never existed.
+"""
+
+from orion_tpu.diagnosis.engine import DoctorRule
+
+
+class CompileStorm(DoctorRule):
+    id = "DX050"
+    name = "compile-storm"
+    severity = "warn"
+    runbook = "dx050-compile-storm"
+    description = (
+        "jax.compiles keeping pace with rounds: the process is paying XLA "
+        "compilation continuously (signature churn across families, or a "
+        "prewarm loop re-warming the same buckets) instead of a handful of "
+        "compiles up front."
+    )
+
+    #: A healthy hunt compiles each family a handful of times (initial
+    #: signatures + pow-2 bucket growths, prewarms included); a storm
+    #: compiles per ROUND.  Both bars must hold, exactly like DX001.
+    MIN_ROUNDS = 10
+    MIN_COMPILES = 20
+    COMPILES_PER_ROUND = 1.0
+
+    def evaluate(self, snapshot):
+        rounds = snapshot.rounds()
+        compiles = snapshot.counter("jax.compiles")
+        if rounds >= self.MIN_ROUNDS and compiles >= max(
+            self.MIN_COMPILES, self.COMPILES_PER_ROUND * rounds
+        ):
+            yield self.finding(
+                f"{compiles} XLA compilations over {rounds} rounds (healthy: "
+                "a handful total across all jit families) — check `orion-tpu "
+                "profile` for which family and which static is churning",
+                value=compiles,
+            )
+
+
+class UnattributedRetrace(DoctorRule):
+    id = "DX051"
+    name = "unattributed-retrace"
+    severity = "warn"
+    runbook = "dx051-unattributed-retrace"
+    description = (
+        "jax.retraces counted without a matching compiler-plane "
+        "attribution: some jit call site books retraces outside the "
+        "CompileRegistry, so `flight.retrace` cannot name the changed "
+        "static — the self-diagnosing contract is broken."
+    )
+
+    def evaluate(self, snapshot):
+        # Gate on the plane being active: a snapshot from a build without
+        # the registry has retraces but no compiles counter at all — that
+        # is missing instrumentation, not an attribution bug.
+        if not snapshot.counter("jax.compiles"):
+            return
+        retraces = snapshot.counter("jax.retraces")
+        attributed = snapshot.counter("jax.retraces.attributed")
+        if retraces > attributed:
+            yield self.finding(
+                f"{retraces - attributed} of {retraces} retraces have no "
+                "compiler-plane attribution — a jit call site counts "
+                "jax.retraces without CompileRegistry.record_retrace "
+                "(the bench smoke gate pins retraces_attributed == retraces)",
+                value=retraces - attributed,
+            )
+
+
+class PrewarmCoveredRetrace(DoctorRule):
+    id = "DX052"
+    name = "prewarm-covered-retrace"
+    severity = "critical"
+    runbook = "dx052-prewarm-covered-retrace"
+    description = (
+        "a synchronous retrace landed at a signature a completed prewarm "
+        "already recorded: the warm compiled something the real dispatch "
+        "then could not reuse — a prewarm bug (statics drift between the "
+        "prewarm closure and the dispatch path), paying both the warm AND "
+        "the stall."
+    )
+
+    def evaluate(self, snapshot):
+        covered = snapshot.counter("jax.retraces.prewarm_covered")
+        if covered:
+            yield self.finding(
+                f"{covered} retrace(s) at signatures prewarm had already "
+                "warmed — the prewarm compile is not hitting the same jit "
+                "cache entry as the dispatch; diff the `flight.retrace` "
+                "signature against the prewarm's in `orion-tpu profile`",
+                value=covered,
+            )
+
+
+class HbmFootprintNearCapacity(DoctorRule):
+    id = "DX053"
+    name = "hbm-footprint-near-capacity"
+    severity = "warn"
+    runbook = "dx053-hbm-footprint-near-capacity"
+    description = (
+        "the largest compiled plan's HBM footprint (arguments + outputs + "
+        "temporaries + generated code) is within the alert fraction of "
+        "device capacity: the next q or history-bucket growth may OOM the "
+        "device instead of compiling."
+    )
+
+    #: Fire when the worst plan pins >= this fraction of device HBM — the
+    #: next pow-2 bucket growth roughly doubles the dominant buffers.
+    CAPACITY_FRACTION = 0.8
+
+    def evaluate(self, snapshot):
+        footprint = snapshot.gauge("compiler.hbm_bytes_max")
+        capacity = snapshot.gauge("compiler.hbm_capacity_bytes")
+        if not footprint or not capacity:
+            return
+        ratio = float(footprint) / float(capacity)
+        if ratio >= self.CAPACITY_FRACTION:
+            yield self.finding(
+                f"largest plan HBM footprint {footprint / 1e9:.2f}GB is "
+                f"{ratio:.0%} of the {capacity / 1e9:.2f}GB device capacity "
+                f"(alert at {self.CAPACITY_FRACTION:.0%}) — the predicted "
+                "HBM-bound q is in `orion-tpu profile`; cap q or the fit "
+                "bucket before the next growth",
+                value=ratio,
+            )
+
+
+COMPILER_RULES = (
+    CompileStorm,
+    UnattributedRetrace,
+    PrewarmCoveredRetrace,
+    HbmFootprintNearCapacity,
+)

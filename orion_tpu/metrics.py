@@ -189,6 +189,12 @@ class _MetricsHandler(http.server.BaseHTTPRequestHandler):
             from orion_tpu.storage.shard import sample_replication_lag
 
             sample_replication_lag()
+            # Compiler-plane gauges (compile_ms_total, hbm_bytes_max,
+            # hbm_bound_q) from already-analyzed entries — publish_gauges
+            # never compiles, so it is scrape-safe.
+            from orion_tpu.compiler_plane import COMPILE_REGISTRY
+
+            COMPILE_REGISTRY.publish_gauges()
             body = render_exposition(self.server.registry.snapshot()).encode()
             content_type = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?", 1)[0] == "/healthz":

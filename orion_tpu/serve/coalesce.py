@@ -25,6 +25,7 @@ buckets.  Padding lanes are discarded un-read; their computation cannot
 influence real lanes (scan lanes are independent).
 """
 
+import time
 from functools import partial
 
 import jax
@@ -33,13 +34,22 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec
 
 from orion_tpu.algo.history import _next_pow2
+from orion_tpu.algo.prewarm import completed_prewarm_count
 from orion_tpu.algo.sharding import (
     TENANT_AXIS,
     get_mesh,
+    mesh_fingerprint,
     mesh_utilization,
     tenant_spec,
 )
 from orion_tpu.algo.tpu_bo import _suggest_step
+from orion_tpu.compiler_plane import (
+    COMPILE_REGISTRY,
+    fields_from_plan_signature,
+    jit_cache_size,
+    lowered_analysis_fn,
+)
+from orion_tpu.telemetry import TELEMETRY
 
 #: Static-arg names of the stacked step — exactly ``_suggest_step``'s, so a
 #: FusedPlan's ``statics`` dict splats into either entry unchanged.
@@ -105,6 +115,18 @@ def _tenant_parallel_suggest_step(stacked, *, tenant_mesh, **statics):
 LAST_STACK_PLACEMENT = {}
 
 
+def _stacked_fields(signature, t_pad, tenant_mesh):
+    """Compiler-plane signature fields of one stacked dispatch: the shared
+    per-lane plan signature plus the tenant-axis statics that fork the
+    stacked jit's own cache (``t_pad`` bucket, tenant-parallel mode).
+    Shared by the dispatch bracket and :func:`prewarm_stacked` so a warm
+    and the retrace it should have covered can never disagree."""
+    fields = fields_from_plan_signature(signature)
+    fields["t_pad"] = int(t_pad)
+    fields["tenant_mesh"] = mesh_fingerprint(tenant_mesh)
+    return fields
+
+
 def stack_plans(plans, t_pad=None):
     """Stack same-signature plans' input arrays along a new leading tenant
     axis, padded to ``t_pad`` (default: the pow-2 bucket of ``len(plans)``)
@@ -166,9 +188,9 @@ def run_coalesced_plans(plans, t_pad=None):
             util_min_frac=lo,
             util_max_frac=hi,
         )
-        statics = dict(plans[0].statics, mesh=None)
-        rows, states = _tenant_parallel_suggest_step(
-            stacked, tenant_mesh=tenant_mesh, **statics
+        step_fn = _tenant_parallel_suggest_step
+        dispatch_statics = dict(
+            plans[0].statics, mesh=None, tenant_mesh=tenant_mesh
         )
     else:
         # No mesh, or a stack too narrow to give every chip a lane: the
@@ -182,7 +204,45 @@ def run_coalesced_plans(plans, t_pad=None):
             )
             LAST_STACK_PLACEMENT.pop("util_min_frac", None)
             LAST_STACK_PLACEMENT.pop("util_max_frac", None)
-        rows, states = _stacked_suggest_step(stacked, **plans[0].statics)
+        step_fn = _stacked_suggest_step
+        dispatch_statics = dict(plans[0].statics)
+    # Retrace bracket — the stacked twin of run_fused_plan's: jit cache
+    # growth during the call with no prewarm completing in the window is a
+    # synchronous compile THIS dispatch paid, attributed by the compiler
+    # plane against the nearest prior stacked signature.
+    tel_t0 = tel_before = None
+    if TELEMETRY.enabled:
+        tel_before = jit_cache_size(step_fn)
+        tel_prewarms_before = completed_prewarm_count()
+        tel_t0 = time.perf_counter()
+    rows, states = step_fn(stacked, **dispatch_statics)
+    if tel_t0 is not None:
+        after = jit_cache_size(step_fn)
+        retraced = (
+            tel_before is not None
+            and after is not None
+            and after > tel_before
+            # A prewarm completing mid-window explains the growth —
+            # classify as a cached dispatch (same conservative call as
+            # run_fused_plan: a coinciding genuine retrace goes uncounted
+            # rather than a cache hit being booked as a stall).
+            and completed_prewarm_count() == tel_prewarms_before
+        )
+        TELEMETRY.record_span(
+            "jax.stacked.compile" if retraced else "jax.stacked.dispatch",
+            start=tel_t0,
+            args={"t_pad": int(t_pad), "lanes": len(plans)},
+        )
+        if retraced:
+            TELEMETRY.count("jax.retraces")
+            COMPILE_REGISTRY.record_retrace(
+                "stacked",
+                _stacked_fields(signature, t_pad, tenant_mesh),
+                seconds=time.perf_counter() - tel_t0,
+                analysis_fn=lowered_analysis_fn(
+                    step_fn, stacked, dispatch_statics
+                ),
+            )
     out = []
     for lane, plan in enumerate(plans):
         lane_state = jax.tree.map(lambda leaf, lane=lane: leaf[lane], states)
@@ -205,14 +265,26 @@ def prewarm_stacked(sample_plan, t_pad):
     )
     statics = dict(sample_plan.statics)
     tenant_mesh = _tenant_mesh_for(statics.get("mesh"), t_pad)
+    signature = sample_plan.signature
 
     def compile_fn():
+        t0 = time.perf_counter()
         if tenant_mesh is None:
             _stacked_suggest_step(dummies, **statics)
         else:
             placed = jax.device_put(dummies, tenant_spec(tenant_mesh))
             _tenant_parallel_suggest_step(
                 placed, tenant_mesh=tenant_mesh, **dict(statics, mesh=None)
+            )
+        if TELEMETRY.enabled:
+            # Book the warmed signature: a later retrace at EXACTLY these
+            # fields is a prewarm bug (doctor rule DX052), not a missing
+            # prewarm — the fields must match the dispatch bracket's, which
+            # is why both go through _stacked_fields.
+            COMPILE_REGISTRY.record_prewarm(
+                "stacked",
+                _stacked_fields(signature, t_pad, tenant_mesh),
+                seconds=time.perf_counter() - t0,
             )
 
     return compile_fn

@@ -501,7 +501,14 @@ class Telemetry:
             "tid": threading.get_ident(),
         }
         if args:
-            record["args"] = dict(args)
+            # Clamp long string values (compiler-plane signatures are the
+            # worst case: every static of a plan on one line) — the ring
+            # holds a bounded record count, not bounded bytes, and a
+            # pathological arg would bloat every export of the window.
+            record["args"] = {
+                k: (v[:253] + "..." if isinstance(v, str) and len(v) > 256 else v)
+                for k, v in args.items()
+            }
         if span_ctx is None and parent_ctx is None:
             ambient = current_trace_context()
             if ambient is not None and ambient.sampled:

@@ -1,0 +1,62 @@
+"""PERF003 fixtures: compiler introspection outside the declared cold path.
+
+Bad shapes: ``cost_analysis()`` / ``memory_analysis()`` calls on any
+receiver (they synchronize on the compiled executable), and the chained
+AOT ``.lower(...).compile()`` (a second full XLA compile) — this fixture
+file is NOT in ``COLD_COMPILER_MODULES``, so they all fire.  Good shapes:
+routing through the compiler plane's shared helpers, a bare
+``.compile(...)`` whose receiver is not a ``.lower(...)`` call, attribute
+REFERENCES without a call, and suppressions carrying the argued
+cold-path reason.
+"""
+
+
+def per_plan_flops(compiled):
+    cost = compiled.cost_analysis()  # expect: PERF003
+    return cost.get("flops")
+
+
+def per_plan_footprint(compiled):
+    mem = compiled.memory_analysis()  # expect: PERF003
+    return mem.temp_size_in_bytes
+
+
+def aot_probe(jitted, spec, statics):
+    compiled = jitted.lower(spec, **statics).compile()  # expect: PERF003
+    return compiled
+
+
+def both_in_one(jitted, spec):
+    return jitted.lower(spec).compile().cost_analysis()  # expect: PERF003
+
+
+def declared_cold_bench(jitted, spec):
+    # lint: disable=PERF003 -- one-shot offline bench; the AOT second
+    # compile is this tool's whole purpose.
+    return jitted.lower(spec).compile()
+
+
+def routed_through_registry(jitted, arrays, statics):
+    # The sanctioned shape: the compiler plane owns the synchronizing
+    # calls; callers hold a closure and invoke it on a declared cold path.
+    from orion_tpu.compiler_plane import lowered_analysis_fn
+
+    return lowered_analysis_fn(jitted, arrays, statics)
+
+
+def plain_compile(pattern, flags):
+    # ``.compile(...)`` whose receiver is NOT a .lower(...) call: quiet
+    # (re.compile-style APIs must not trip the AOT-chain detector).
+    return pattern.compile(flags)
+
+
+def lower_without_compile(jitted, spec):
+    # Lowering alone does not synchronize: quiet.
+    return jitted.lower(spec)
+
+
+def attribute_reference_only(compiled):
+    # A reference without a call is how the registry passes the bound
+    # method around: quiet.
+    probe = compiled.cost_analysis
+    return probe

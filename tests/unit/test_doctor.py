@@ -271,6 +271,98 @@ FIXTURES = {
         ),
         Snapshot(health=_health(16, mem_bytes=5e6), now=NOW),
     ),
+    # Compiler plane (PR 18).  DX050: compiles keeping pace with rounds —
+    # no jax.retraces counter, so the DX001 storm rule stays quiet and the
+    # exactness assertion holds.
+    "DX050": (
+        Snapshot(
+            metrics=_metrics(
+                counters={"jax.compiles": 30},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={"jax.compiles": 6},
+                histograms={"producer.round": _hist(20, 0.05)},
+            ),
+            now=NOW,
+        ),
+    ),
+    # DX051: retraces outrunning attribution.  No rounds histogram (keeps
+    # DX050/DX001 quiet); the rule itself gates on jax.compiles > 0, so a
+    # snapshot from a build without the plane never fires it.
+    "DX051": (
+        Snapshot(
+            metrics=_metrics(
+                counters={
+                    "jax.compiles": 5,
+                    "jax.retraces": 8,
+                    "jax.retraces.attributed": 3,
+                }
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={
+                    "jax.compiles": 5,
+                    "jax.retraces": 8,
+                    "jax.retraces.attributed": 8,
+                }
+            ),
+            now=NOW,
+        ),
+    ),
+    # DX052: a retrace at a signature prewarm already warmed — attribution
+    # complete (retraces == attributed keeps DX051 quiet), yet the warm
+    # bought nothing.
+    "DX052": (
+        Snapshot(
+            metrics=_metrics(
+                counters={
+                    "jax.compiles": 2,
+                    "jax.retraces": 2,
+                    "jax.retraces.attributed": 2,
+                    "jax.retraces.prewarm_covered": 2,
+                }
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                counters={
+                    "jax.compiles": 2,
+                    "jax.retraces": 2,
+                    "jax.retraces.attributed": 2,
+                    "jax.retraces.prewarm_covered": 0,
+                }
+            ),
+            now=NOW,
+        ),
+    ),
+    # DX053: the worst plan pins 87.5% of device HBM (alert bar 80%).
+    "DX053": (
+        Snapshot(
+            metrics=_metrics(
+                gauges={
+                    "compiler.hbm_bytes_max": 14e9,
+                    "compiler.hbm_capacity_bytes": 16e9,
+                }
+            ),
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                gauges={
+                    "compiler.hbm_bytes_max": 4e9,
+                    "compiler.hbm_capacity_bytes": 16e9,
+                }
+            ),
+            now=NOW,
+        ),
+    ),
 }
 
 
@@ -844,6 +936,11 @@ def test_bench_history_hook(tmp_path):
         "value": 123.0,
         "regret_gate": {"pass": True},
         "doctor_critical": 0,
+        "compiler": {
+            "compile_ms_total": 321.0,
+            "retraces_attributed": 2,
+            "plan_hbm_bytes_max": None,
+        },
     }
     # Smoke payloads append nowhere by default (tier-1 runs --smoke
     # constantly; the committed series must not grow a line per CI run).
@@ -859,6 +956,12 @@ def test_bench_history_hook(tmp_path):
     assert lines[0]["value"] == 123.0
     assert lines[0]["regret_gate_pass"] is True
     assert lines[0]["doctor_critical"] == 0
+    # The compiler-plane columns are PRESENT even when None (a backend
+    # without memory_analysis legitimately reports no footprint).
+    assert lines[0]["compile_ms_total"] == 321.0
+    assert lines[0]["retraces_attributed"] == 2
+    assert "plan_hbm_bytes_max" in lines[0]
+    assert lines[0]["plan_hbm_bytes_max"] is None
     assert lines[1]["smoke"] is False
 
 
