@@ -688,6 +688,291 @@ def bench_serve(m_tenants=2, rounds=4, q=8, window=0.4, n_candidates=256,
     }
 
 
+def bench_serve_fleet(m_gateways=3, n_tenants=6, rounds=4, q=8, window=0.4,
+                      n_candidates=256, fit_steps=4, priors=None,
+                      algorithms=None, name_prefix="bench-fleet"):
+    """The gateway FLEET leg (ISSUE 19): K tenants ring-routed over M
+    gateway processes with one member killed mid-stream.
+
+    Two passes with identical seeds: a single-gateway reference run, then
+    the fleet run — M members sharing a per-tenant snapshot store, every
+    client routing by the consistent-hash ring (``serve.addresses``), and
+    the member owning the MOST tenants killed (simulated crash, no
+    farewell snapshot) at the mid-stream round barrier while suggests are
+    in flight.  Hard gates (SystemExit, not assert — must hold under
+    ``python -O``):
+
+    - **bit-identical**: every tenant's suggestion stream matches its
+      single-gateway reference exactly — failover + store restore +
+      replay never fork a trajectory;
+    - **zero lost observations**: each tenant's gateway-side count equals
+      ``rounds * q`` on whichever surviving member hosts it;
+    - **fleet-wide amortization**: total device dispatches / total
+      suggests < 1 across ALL members — the per-process coalescing win
+      survives the scale-out (ring co-residents still stack);
+    - **the kill bit**: at least one client failover actually happened,
+      and every tenant experiment passes ``orion-tpu audit``.
+
+    Returns the ``serve_fleet`` payload block."""
+    import os
+    import socket
+    import tempfile
+    import threading
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.client.experiment import ExperimentClient
+    from orion_tpu.core.experiment import build_experiment
+    from orion_tpu.serve.fleet import FleetState, ring_key
+    from orion_tpu.serve.gateway import GatewayServer
+    from orion_tpu.storage.audit import audit_experiment
+    from orion_tpu.storage.base import create_storage
+
+    if priors is None:
+        priors = {f"x{j}": "uniform(0, 1)" for j in range(6)}
+    if algorithms is None:
+        algorithms = {
+            "tpu_bo": {
+                "n_init": q,
+                "n_candidates": n_candidates,
+                "fit_steps": fit_steps,
+            }
+        }
+    x_names = sorted(
+        (k for k in priors if k.startswith("x")), key=lambda k: int(k[1:])
+    )
+
+    def objective_values(X):
+        # Hartmann6 over the x* columns; narrower spaces ride zero-padded.
+        if X.shape[1] < 6:
+            X = np.concatenate(
+                [X, np.zeros((len(X), 6 - X.shape[1]), dtype=X.dtype)], axis=1
+            )
+        return [float(v) for v in _hartmann6_np(X)]
+
+    def run_pass(serve_config, storage, barrier, controller=None):
+        """Drive every tenant for ``rounds`` barrier-synchronized rounds.
+        ``controller`` (fleet pass) participates in the same barrier from
+        the calling thread — that is what lets it kill a member while the
+        round's suggests are genuinely in flight."""
+        streams, reports, errors = {}, {}, []
+
+        def run_tenant(index):
+            try:
+                experiment = build_experiment(
+                    storage,
+                    f"{name_prefix}-{index}",
+                    priors=priors,
+                    algorithms=algorithms,
+                    pool_size=q,
+                    metadata={"user": "bench"},
+                )
+                experiment.serve_config = dict(serve_config)
+                experiment.instantiate(seed=SEED + index)
+                client = ExperimentClient(experiment)
+                stream = []
+                for _ in range(rounds):
+                    barrier.wait(timeout=300)
+                    trials = client.suggest(q)
+                    X = np.asarray(
+                        [
+                            [t.params[name] for name in x_names]
+                            for t in trials
+                        ],
+                        dtype=np.float32,
+                    )
+                    stream.append(X.tolist())
+                    client.observe_all(trials, objective_values(X))
+                # The Producer pushes completed trials to the gateway on
+                # the NEXT suggest; flush so the last round's batch is
+                # gateway-side before the zero-loss gate counts it.
+                client.producer.update()
+                streams[index] = stream
+                reports[index] = audit_experiment(storage, experiment)
+            except Exception as exc:  # surfaced after join
+                errors.append(exc)
+                barrier.abort()
+
+        threads = [
+            threading.Thread(target=run_tenant, args=(i,), daemon=True)
+            for i in range(n_tenants)
+        ]
+        for thread in threads:
+            thread.start()
+        if controller is not None:
+            controller()
+        for thread in threads:
+            thread.join(timeout=500)
+        if errors:
+            raise SystemExit(f"fleet bench tenant failed: {errors[0]!r}")
+        return streams, reports
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    failovers_before = int(tel.TELEMETRY.counter_value("serve.client.failovers"))
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-fleet-") as tmp:
+            # --- reference pass: the same tenants through ONE gateway ----
+            ref_server = GatewayServer(
+                window=window, max_width=max(2, n_tenants)
+            )
+            ref_host, ref_port = ref_server.serve_background()
+            try:
+                ref_streams, _ = run_pass(
+                    {"address": f"{ref_host}:{ref_port}"},
+                    create_storage(
+                        {"type": "sqlite",
+                         "path": os.path.join(tmp, "ref.sqlite")}
+                    ),
+                    threading.Barrier(n_tenants),
+                )
+            finally:
+                ref_server.shutdown()
+                ref_server.server_close()
+
+            # --- fleet pass: M members, shared store, mid-stream kill ----
+            def free_port():
+                sock = socket.socket()
+                sock.bind(("127.0.0.1", 0))
+                port = sock.getsockname()[1]
+                sock.close()
+                return port
+
+            members = [f"127.0.0.1:{free_port()}" for _ in range(m_gateways)]
+            store = os.path.join(tmp, "tenant-store")
+            gateways = [
+                GatewayServer(
+                    host="127.0.0.1",
+                    port=int(member.rsplit(":", 1)[1]),
+                    window=window,
+                    max_width=max(2, n_tenants),
+                    fleet=members,
+                    advertise=member,
+                    persist=store,
+                )
+                for member in members
+            ]
+            for gateway in gateways:
+                gateway.serve_background()
+
+            # Ring placement is known before any traffic (same HashRing on
+            # every client); kill the member owning the MOST tenants so
+            # the handoff path carries real load.
+            fleet_state = FleetState(members)
+            worker = f"{socket.gethostname()}:{os.getpid()}"
+            tenant_names = [
+                f"{name_prefix}-{index}-v1@{worker}"
+                for index in range(n_tenants)
+            ]
+            placement = {member: 0 for member in members}
+            for tenant in tenant_names:
+                placement[fleet_state.owner(ring_key(tenant))] += 1
+            victim_addr = max(placement, key=placement.get)
+            victim = gateways[members.index(victim_addr)]
+            survivors = [g for g in gateways if g is not victim]
+            kill_round = rounds // 2
+            barrier = threading.Barrier(n_tenants + 1)
+
+            def controller():
+                for round_index in range(rounds):
+                    try:
+                        barrier.wait(timeout=300)
+                    except threading.BrokenBarrierError:
+                        return
+                    if round_index == kill_round:
+                        # Simulated crash while the round's suggests are
+                        # in flight: no farewell snapshot — durability
+                        # must come from the sync persist-before-reply
+                        # path alone.
+                        victim.kill()
+
+            try:
+                fleet_streams, reports = run_pass(
+                    {"addresses": list(members)},
+                    create_storage(
+                        {"type": "sqlite",
+                         "path": os.path.join(tmp, "fleet.sqlite")}
+                    ),
+                    barrier,
+                    controller=controller,
+                )
+            finally:
+                for gateway in survivors:
+                    gateway.shutdown()
+                    gateway.server_close()
+            # Stats survive shutdown (counters on the server object);
+            # the victim's froze at the kill.
+            stats = [gateway.stats_snapshot() for gateway in gateways]
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    failovers = (
+        int(tel.TELEMETRY.counter_value("serve.client.failovers"))
+        - failovers_before
+    )
+
+    # --- the gates (SystemExit: must hold under `python -O`) -------------
+    for index in range(n_tenants):
+        if fleet_streams.get(index) != ref_streams.get(index):
+            raise SystemExit(
+                f"fleet stream FORKED for tenant {index}: the killed-"
+                "member run diverged from its single-gateway reference"
+            )
+    survivor_stats = [
+        s for s, g in zip(stats, gateways) if g is not victim
+    ]
+    lost = {}
+    for tenant in tenant_names:
+        observed = max(
+            (s["per_tenant"].get(tenant, {}).get("n_observed", 0)
+             for s in survivor_stats),
+            default=0,
+        )
+        if observed != rounds * q:
+            lost[tenant] = observed
+    if lost:
+        raise SystemExit(
+            f"fleet run LOST observations (want {rounds * q} each): {lost}"
+        )
+    total_suggests = sum(s["suggests"] for s in stats)
+    total_dispatches = sum(s["dispatches"] for s in stats)
+    ratio = (
+        total_dispatches / total_suggests if total_suggests else None
+    )
+    if ratio is None or ratio >= 1.0:
+        raise SystemExit(
+            f"fleet-wide dispatches per suggest = {ratio} (must be < 1 "
+            f"across {m_gateways} gateways): {stats}"
+        )
+    if failovers < 1:
+        raise SystemExit(
+            "the mid-stream kill never bit: no client failover happened "
+            f"(victim {victim_addr} owned {placement[victim_addr]} tenants)"
+        )
+    audit_violations = sum(len(r.violations) for r in reports.values())
+    if any(not r.ok for r in reports.values()):
+        raise SystemExit(
+            "fleet run audits dirty: "
+            f"{ {i: r.summary() for i, r in reports.items() if not r.ok} }"
+        )
+    return {
+        "gateways": m_gateways,
+        "tenants": n_tenants,
+        "rounds": rounds,
+        "q": q,
+        "killed": victim_addr,
+        "kill_round": kill_round,
+        "placement": placement,
+        "suggests": total_suggests,
+        "device_dispatches": total_dispatches,
+        "dispatches_per_suggest": round(ratio, 4),
+        "coalesce_max_width": max(s["max_width"] for s in stats),
+        "failovers": failovers,
+        "bit_identical": True,
+        "lost_observations": 0,
+        "audit_violations": audit_violations,
+    }
+
+
 def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
     """``bench.py --serve``: the gateway serving M concurrent experiments —
     prints ONE json line with the coalesce/latency/dispatch-amortization
@@ -705,6 +990,14 @@ def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
             "serve": bench_serve(
                 m_tenants=m_tenants, rounds=rounds, q=q, n_candidates=1024,
                 fit_steps=8,
+            ),
+            # The fleet headline (ISSUE 19): M=3 gateways x K tenants with
+            # a mid-stream member kill — bit-identical streams, zero lost
+            # observations, fleet-wide dispatches/suggest < 1, all
+            # SystemExit-gated inside.
+            "serve_fleet": bench_serve_fleet(
+                m_gateways=3, n_tenants=6, rounds=rounds, q=q,
+                n_candidates=1024, fit_steps=8,
             ),
         }
         print(json.dumps(payload))
@@ -751,6 +1044,13 @@ def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
         db_server.server_close()
         if not was_enabled:
             tel.TELEMETRY.disable()
+    # The 2-gateway fleet twin of the full run's M=3 leg: kill one member
+    # mid-stream — zero lost, bit-identical streams, clean audits, all
+    # SystemExit-gated inside bench_serve_fleet.
+    serve_fleet_block = bench_serve_fleet(
+        m_gateways=2, n_tenants=3, rounds=4, q=8, window=0.4,
+        n_candidates=128, fit_steps=4,
+    )
     spans = [s for s in tel.TELEMETRY.iter_spans() if s] + list(server_spans)
     trace_path = "bench_serve_trace.json"
     tel.write_chrome_trace(trace_path, spans)
@@ -759,6 +1059,7 @@ def main_serve(m_tenants=4, rounds=6, q=16, smoke=False):
         "metric": "serve gateway smoke (distributed trace)",
         "serve": serve_block,
         "serve_asha": serve_asha_block,
+        "serve_fleet": serve_fleet_block,
         "serve_trace_file": trace_path,
         "trace": joined,
     }
@@ -1008,6 +1309,7 @@ def bench_history_record(payload, now=None):
     and trace blocks."""
     gate = payload.get("regret_gate") or {}
     compiler = payload.get("compiler") or {}
+    sharded = payload.get("sharded") or {}
     return {
         "schema_version": payload.get("schema_version"),
         "time": time.time() if now is None else now,
@@ -1029,6 +1331,12 @@ def bench_history_record(payload, now=None):
         "compile_ms_total": compiler.get("compile_ms_total"),
         "retraces_attributed": compiler.get("retraces_attributed"),
         "plan_hbm_bytes_max": compiler.get("plan_hbm_bytes_max"),
+        # Sharded q-walk columns (ISSUE 19 satellite): the predicted
+        # HBM-bound q and the measured-vs-predicted headroom from the
+        # --sharded leg — None on runs without it (or on backends whose
+        # memory analysis is unknowable), present always.
+        "sharded_hbm_bound_q": sharded.get("hbm_bound_q"),
+        "sharded_hbm_headroom": sharded.get("hbm_headroom"),
     }
 
 
@@ -1666,6 +1974,61 @@ def bench_sharded(smoke=False):
             "single_sps": round(sps_single, 1),
             "ratio": round(sps_sharded / sps_single, 3),
         })
+
+    # --- q-walk toward the predicted HBM bound (ROADMAP item 1 tail) -----
+    # Double q from the curve's floor until the NEXT doubling would cross
+    # the compiler plane's predict_hbm_bound_q extrapolation (or an OOM
+    # guard trips, or the step cap on unknown-capacity backends).  Each
+    # step's footprint comes from the sanctioned lowered_analysis_fn path
+    # — a bench IS a declared cold path, the AOT second compile is fine.
+    from orion_tpu.algo.tpu_bo import _suggest_step
+    from orion_tpu.compiler_plane import (
+        device_hbm_capacity,
+        lowered_analysis_fn,
+        predict_hbm_bound_q,
+    )
+
+    capacity = device_hbm_capacity()
+    walk_algo = fresh_algo(True)
+    q_walk, bound_q = [], None
+    walk_q = qs[0]
+    for _ in range(3 if smoke else 6):
+        plan = walk_algo.fused_step_plan(walk_q)
+        analysis = (
+            lowered_analysis_fn(_suggest_step, plan.arrays, plan.statics)()
+            or {}
+        )
+        hbm_bytes = analysis.get("hbm_bytes")
+        predicted = predict_hbm_bound_q({"q": walk_q}, hbm_bytes, capacity)
+        try:
+            t0 = time.perf_counter()
+            np.asarray(run_fused_plan(plan)[0])
+            wall_ms, oom = round((time.perf_counter() - t0) * 1e3, 2), False
+        except Exception:  # the OOM guard: record the wall and stop
+            wall_ms, oom = None, True
+        q_walk.append({
+            "q": walk_q,
+            "plan_hbm_bytes": hbm_bytes,
+            "predicted_hbm_bound_q": predicted,
+            "wall_ms": wall_ms,
+            "oom": oom,
+        })
+        if oom:
+            break
+        if predicted is not None:
+            bound_q = predicted
+            if 2 * walk_q >= predicted:
+                break  # the next doubling would cross the predicted bound
+        walk_q *= 2
+    measured = [row["q"] for row in q_walk if not row["oom"]]
+    walk_max_q = max(measured) if measured else None
+    # Measured-vs-predicted headroom: how many x of q the device still has
+    # before the plan footprint fills HBM (None when capacity or the
+    # memory analysis is unknowable — CPU interop backends).
+    hbm_headroom = (
+        round(bound_q / walk_max_q, 2) if bound_q and walk_max_q else None
+    )
+
     try:
         host_parallelism = len(os.sched_getaffinity(0))
     except AttributeError:  # pragma: no cover - non-Linux host
@@ -1684,6 +2047,11 @@ def bench_sharded(smoke=False):
         "placement": placement,
         "devices_holding_shards": devices_holding,
         "q_curve": q_curve,
+        "q_walk": q_walk,
+        "q_walk_max_q": walk_max_q,
+        "hbm_capacity_bytes": capacity,
+        "hbm_bound_q": bound_q,
+        "hbm_headroom": hbm_headroom,
         "smoke": smoke,
     }
 

@@ -411,11 +411,22 @@ def main(args):
         # Fleet view over a sharded control plane: say which topology
         # answered (build_all_experiments resolved through the router, so
         # experiments from EVERY shard are in the list).
-        from orion_tpu.cli.base import describe_storage_topology
+        from orion_tpu.cli.base import (
+            describe_serve_fleet,
+            describe_storage_topology,
+            load_cli_config,
+        )
 
         topology = describe_storage_topology(probe=True)
         if topology is not None:
             print(topology)
+        # Serve-plane twin of the storage header: one `fleet` probe per
+        # configured gateway (per-member tenant counts, queue depth, and
+        # the membership epoch — epoch=SPLIT is the drift smell DX007's
+        # runbook starts from).
+        gateways = describe_serve_fleet(load_cli_config(args).get("serve"))
+        if gateways is not None:
+            print(gateways)
         if not experiments:
             print("no experiments in storage")
             return 0

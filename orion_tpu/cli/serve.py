@@ -67,7 +67,35 @@ def add_subparser(subparsers):
         default=None,
         metavar="path",
         help="snapshot tenant state (history, trust region, RNG stream) so "
-        "a restarted gateway resumes its tenants without client replay",
+        "a restarted gateway resumes its tenants without client replay.  "
+        "In fleet mode this is a DIRECTORY of per-tenant snapshots "
+        "(shared storage lets a survivor restore a killed member's "
+        "tenants bit-identically)",
+    )
+    parser.add_argument(
+        "--fleet",
+        default=None,
+        metavar="addr1,addr2,...",
+        help="run as one member of a gateway fleet: the full comma-"
+        "separated member list (this gateway included).  Tenants are "
+        "placed on members by consistent hash; membership changes "
+        "(fleet_set) migrate tenants through a fenced zero-loss handoff "
+        "(docs/serving.md \"Fleet deployment\")",
+    )
+    parser.add_argument(
+        "--advertise",
+        default=None,
+        metavar="host:port",
+        help="this member's own address exactly as it appears in --fleet "
+        "(defaults to host:port when that spelling is in the list)",
+    )
+    parser.add_argument(
+        "--handoff-ttl",
+        type=float,
+        default=30.0,
+        metavar="s",
+        help="seconds a fenced tenant may stay in handoff before the "
+        "DX008 doctor rule calls it stuck (default 30)",
     )
     parser.add_argument(
         "--metrics-port",
@@ -128,6 +156,11 @@ def main(args):  # pragma: no cover - thin CLI shim over serve()
         from orion_tpu.telemetry import TELEMETRY
 
         TELEMETRY.enable()
+    fleet = None
+    advertise = None
+    if args.fleet:
+        fleet = [s.strip() for s in args.fleet.split(",") if s.strip()]
+        advertise = args.advertise or f"{args.host}:{args.port}"
     serve(
         host=args.host,
         port=args.port,
@@ -140,5 +173,8 @@ def main(args):  # pragma: no cover - thin CLI shim over serve()
         persist=args.persist,
         metrics_port=args.metrics_port,
         secret=secret,
+        fleet=fleet,
+        advertise=advertise,
+        handoff_ttl=args.handoff_ttl,
     )
     return 0

@@ -435,7 +435,7 @@ def render_top(snap):
     return "\n".join(lines)
 
 
-def render_fleet(snaps):
+def render_fleet(snaps, serve_config=None):
     """The ``--all`` frame: one row per experiment — the operator's view of
     a gateway hosting many tenants (who is producing, who is stalled, where
     the fleet incumbents sit) without running N ``top`` processes."""
@@ -444,7 +444,7 @@ def render_fleet(snaps):
         f"{'best_y':>12} {'retry':>5} {'reconn':>6}"
     )
     lines = [f"orion-tpu top --all   experiments: {len(snaps)}"]
-    from orion_tpu.cli.base import describe_storage_topology
+    from orion_tpu.cli.base import describe_serve_fleet, describe_storage_topology
 
     # probe=True: the fleet header shows per-shard epoch + replication lag
     # (one tiny seq request per node per frame — the operator's first
@@ -455,6 +455,12 @@ def render_fleet(snaps):
         # The fleet the table shows spans every shard (the router resolved
         # it); the header says so.
         lines.append(topology)
+    # The serve plane gets the same treatment: one `fleet` probe per
+    # gateway per frame (answered inline by the handler, so it renders
+    # even when a member's dispatcher is saturated).
+    gateways = describe_serve_fleet(serve_config)
+    if gateways is not None:
+        lines.append(gateways)
     lines += ["", header, "-" * len(header)]
     for snap in snaps:
         rounds = sum(row["rounds"] for row in snap["workers"].values())
@@ -482,7 +488,12 @@ def main(args):
         snapshot = lambda: [  # noqa: E731
             snapshot_top(e) for e in build_all_experiments(args)
         ]
-        render = render_fleet
+        from orion_tpu.cli.base import load_cli_config
+
+        serve_config = load_cli_config(args).get("serve")
+        render = lambda snaps: render_fleet(  # noqa: E731
+            snaps, serve_config=serve_config
+        )
         as_json = lambda snaps: {"experiments": snaps}  # noqa: E731
     else:
         experiment, _parser = build_from_args(

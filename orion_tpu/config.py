@@ -121,7 +121,10 @@ DEFAULTS = {
     # "retry": {...}, "quotas": {"max_inflight": n, "max_q": n},
     # "timeout": s} = drive this experiment's suggest/observe through the
     # shared gateway (the ORION_SERVE_ADDRESS env var sets the address
-    # alone).
+    # alone).  A fleet is the same section with "addresses": [host:port,
+    # ...] (env: ORION_SERVE_ADDRESSES, comma-separated): tenants are
+    # placed on members by consistent hash (docs/serving.md "Fleet
+    # deployment").
     "serve": None,
 }
 
@@ -156,6 +159,14 @@ def _env_config():
     serve_address = os.getenv("ORION_SERVE_ADDRESS")
     if serve_address:
         out["serve"] = {"address": serve_address}
+    serve_addresses = os.getenv("ORION_SERVE_ADDRESSES")
+    if serve_addresses:
+        # Fleet membership: comma-separated member list.  Wins over the
+        # single-address spelling when both are set (the list is the more
+        # specific deployment statement).
+        out.setdefault("serve", {})["addresses"] = [
+            s.strip() for s in serve_addresses.split(",") if s.strip()
+        ]
     # Explicit coercions — the DEFAULTS values are None, so their type can't
     # be used to coerce, and a string max_trials would poison comparisons.
     for key, cast in (("max_trials", float), ("pool_size", int), ("max_broken", int)):

@@ -237,6 +237,84 @@ class MeshUtilizationSkew(DoctorRule):
                 )
 
 
+class FleetTenantSkew(DoctorRule):
+    id = "DX007"
+    name = "tenant-skew"
+    severity = "warn"
+    runbook = "dx007-tenant-skew"
+    description = (
+        "the gateway fleet's tenant placement is lopsided: one member "
+        "hosts far more than its even share of tenants — its device "
+        "serializes the coalesced dispatches the other members' idle "
+        "devices should be absorbing."
+    )
+
+    #: Worst member's tenant count vs the even total/members share.  The
+    #: consistent-hash ring balances to within small factors at scale;
+    #: sustained 2x means hot experiments hash-collided onto one member
+    #: (or the membership list drifted between clients and gateways).
+    SKEW_FACTOR = 2.0
+    #: Judgement gate: tiny fleets are lumpy by nature (3 tenants over 3
+    #: members CAN land 2/1/0 legitimately).
+    MIN_TENANTS = 8
+
+    def evaluate(self, snapshot):
+        gauges = snapshot.metrics.get("gauges") or {}
+        per_member = {
+            name: float(value)
+            for name, value in gauges.items()
+            if name.startswith("serve.fleet.tenants.g")
+        }
+        if len(per_member) < 2:
+            return
+        total = sum(per_member.values())
+        if total < self.MIN_TENANTS:
+            return
+        worst_member, worst = max(per_member.items(), key=lambda kv: kv[1])
+        even = total / len(per_member)
+        if worst >= self.SKEW_FACTOR * even:
+            yield self.finding(
+                f"fleet member {worst_member.rsplit('.', 1)[-1]} hosts "
+                f"{worst:g} of {total:g} tenants vs the even "
+                f"{even:g}-per-member share over {len(per_member)} members "
+                "— placement is collapsing onto one gateway (check that "
+                "every client and member agrees on the fleet list, then "
+                "rebalance with fleet_set)",
+                value=worst,
+                subject=worst_member,
+            )
+
+
+class HandoffStuck(DoctorRule):
+    id = "DX008"
+    name = "handoff-stuck"
+    severity = "critical"
+    runbook = "dx008-handoff-stuck"
+    description = (
+        "a fenced tenant is older than the handoff TTL: a fleet "
+        "migration froze mid-flight and the tenant answers RETRY-AFTER "
+        "forever — its workers are stalled, not failing over (the state "
+        "still lives on the fenced member)."
+    )
+
+    #: The gateway's own --handoff-ttl default
+    #: (orion_tpu.serve.fleet.HANDOFF_TTL_S).  A handoff is one snapshot
+    #: push — milliseconds to seconds; half a minute fenced means the
+    #: destination hung or died mid-import.
+    TTL_S = 30.0
+
+    def evaluate(self, snapshot):
+        age = snapshot.gauge("serve.fleet.fenced_age_s", default=0.0)
+        if age > self.TTL_S:
+            yield self.finding(
+                f"a tenant has been fenced for {age:g}s (> {self.TTL_S:g}s "
+                "handoff TTL) — the migration's destination never acked "
+                "the import; restart the fenced member (its store/persist "
+                "snapshot unfences on boot) or re-run fleet_set",
+                value=age,
+            )
+
+
 SYSTEM_RULES = (
     RetraceStorm,
     HeartbeatLag,
@@ -244,4 +322,6 @@ SYSTEM_RULES = (
     HostBudgetBreach,
     ServeQueueSaturation,
     MeshUtilizationSkew,
+    FleetTenantSkew,
+    HandoffStuck,
 )

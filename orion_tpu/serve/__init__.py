@@ -17,9 +17,17 @@ from orion_tpu.serve.client import (  # noqa: F401
     RemoteAlgorithm,
     connect_remote_algorithm,
 )
+from orion_tpu.serve.fleet import (  # noqa: F401
+    FleetRouter,
+    FleetState,
+    TenantStore,
+    parse_serve_addresses,
+    ring_key,
+)
 from orion_tpu.serve.gateway import GatewayServer  # noqa: F401
 from orion_tpu.serve.protocol import (  # noqa: F401
     GatewayError,
     RetryAfterError,
     UnknownTenantError,
+    WrongGatewayError,
 )

@@ -44,10 +44,18 @@ import numpy as np
 
 from orion_tpu.algo.base import BaseAlgorithm
 from orion_tpu.analysis.sanitizer import TSAN
+from orion_tpu.serve.fleet import (
+    FLEET_MAX_HOPS,
+    FLEET_RETRY_DEFAULTS,
+    FleetRouter,
+    parse_serve_addresses,
+    ring_key,
+)
 from orion_tpu.serve.protocol import (
     GatewayError,
     RetryAfterError,
     UnknownTenantError,
+    WrongGatewayError,
     dumps_line,
     read_line,
 )
@@ -246,6 +254,16 @@ class GatewayClient:
             )
         if error == "UnknownTenant":
             raise UnknownTenantError(message)
+        if error == "WrongGateway":
+            # Fleet placement refusal: fatal to the retry policy (this
+            # member will keep refusing), handled by the router one level
+            # up — the reply carries the authoritative membership.
+            raise WrongGatewayError(
+                message,
+                owner=response.get("owner"),
+                addresses=response.get("addresses"),
+                epoch=response.get("epoch"),
+            )
         if error == "AuthenticationError":
             # Fatal to the retry policy — re-sending the same credentials
             # can only repeat the refusal.
@@ -288,11 +306,18 @@ class GatewayClient:
             return call()
         return self._policy.run(call, op=f"serve.{op}", mode=mode)
 
+    @property
+    def address(self):
+        return f"{self.host}:{self.port}"
+
     def ping(self):
         return self.request("ping") == "pong"
 
     def stats(self):
         return self.request("stats")
+
+    def fleet(self):
+        return self.request("fleet")
 
 
 class RemoteAlgorithm(BaseAlgorithm):
@@ -312,7 +337,7 @@ class RemoteAlgorithm(BaseAlgorithm):
 
     def __init__(
         self, space, priors, algo_config, client, tenant, seed=None,
-        quotas=None,
+        quotas=None, router=None,
     ):
         super().__init__(space, seed=seed)
         self._priors = dict(priors)
@@ -320,6 +345,14 @@ class RemoteAlgorithm(BaseAlgorithm):
         self._client = client
         self._tenant = tenant
         self._quotas = dict(quotas or {})
+        # Fleet routing (None = single gateway, the pre-fleet path
+        # verbatim): the router owns one client + one retry policy per
+        # member; ``_resolve`` re-points ``_client`` at the ring owner
+        # before every logical op, and ``_rpc``'s hop loop handles
+        # WrongGateway adoption and dead-member failover.
+        self._router = router
+        self._ring_key = ring_key(tenant)
+        self._takeover = False
         self._naive = False
         self._naive_epoch = 0
         self._lies = []
@@ -339,6 +372,7 @@ class RemoteAlgorithm(BaseAlgorithm):
             "health": None,  # last gateway-reported health record
             "attached": False,
             "wants_register": False,
+            "gateway": getattr(client, "address", None),
         }
 
     # --- naive-clone protocol ----------------------------------------------
@@ -361,40 +395,103 @@ class RemoteAlgorithm(BaseAlgorithm):
         return self._shared["seq"]
 
     # --- wire plumbing -------------------------------------------------------
+    def _resolve(self):
+        """Point ``_client`` at the ring owner (fleet mode).  Sets the
+        takeover flag when the owner is only reachable off-ring (the real
+        owner is marked down) — the next attach must declare it."""
+        if self._router is None:
+            return
+        address, takeover = self._router.resolve(self._ring_key)
+        self._takeover = takeover
+        client = self._router.client(address)
+        if client is not self._client:
+            self._client = client
+            self._shared["gateway"] = address
+            self._shared["attached"] = False
+
     def _rpc(self, op, payload, mode=MODE_ALWAYS):
+        """One logical op.  Single-gateway mode is the original PR 8
+        contract: UnknownTenant -> re-attach + replay + one re-ask.  Fleet
+        mode wraps that in a bounded re-resolve loop: ``WrongGateway``
+        adopts the reply's membership and re-routes; a transport failure
+        that exhausted the member's own retry policy marks it down and
+        fails over to the ring's survivor (re-attaching there restores
+        the persisted snapshot or replays the log — ledger dedup makes
+        either path convergent)."""
         payload = dict(payload, tenant=self._tenant)
-        self._ensure_attached()
-        try:
-            return self._client.request(op, payload, mode=mode)
-        except UnknownTenantError:
-            # Gateway restarted without persist (or evicted this tenant):
-            # re-attach and replay the client-side observation log, then
-            # re-ask the original op exactly once.
-            log.info(
-                "gateway lost tenant %r; re-attaching and replaying %d "
-                "observation batches",
-                self._tenant,
-                len(self._shared["obs_log"]),
-            )
-            self._attach(replay=True)
-            return self._client.request(op, payload, mode=mode)
+        hops = FLEET_MAX_HOPS if self._router is not None else 1
+        last_error = None
+        for _ in range(hops):
+            self._resolve()
+            try:
+                self._ensure_attached()
+                try:
+                    return self._client.request(op, payload, mode=mode)
+                except UnknownTenantError:
+                    # Gateway restarted without persist (or evicted this
+                    # tenant): re-attach and replay the client-side
+                    # observation log, then re-ask the original op once.
+                    log.info(
+                        "gateway lost tenant %r; re-attaching and replaying "
+                        "%d observation batches",
+                        self._tenant,
+                        len(self._shared["obs_log"]),
+                    )
+                    self._attach(replay=True)
+                    return self._client.request(op, payload, mode=mode)
+            except WrongGatewayError as exc:
+                if self._router is None:
+                    raise
+                self._router.adopt(exc.addresses, exc.epoch)
+                if exc.owner:
+                    # The refusing member vouches for the owner: clear any
+                    # stale down-mark so the re-resolve can reach it.
+                    self._router.mark_up(exc.owner)
+                self._shared["attached"] = False
+                last_error = exc
+                continue
+            except RetryAfterError:
+                # Saturation/fence backpressure that outlived the member's
+                # whole policy is not death — surface it, don't fail over
+                # (the tenant's state is THERE; a takeover would fork it).
+                raise
+            except AuthenticationError:
+                raise
+            except DatabaseError as exc:
+                # Transport failure after the member's own policy gave up:
+                # mark it down and fail over to the ring's survivor.
+                if self._router is None:
+                    raise
+                self._router.mark_down(self._client.address)
+                self._shared["attached"] = False
+                TELEMETRY.count("serve.client.failovers")
+                log.warning(
+                    "gateway %s unreachable for tenant %r (%s); failing "
+                    "over", self._client.address, self._tenant, exc,
+                )
+                last_error = exc
+                continue
+        raise last_error
 
     def _ensure_attached(self):
         if not self._shared["attached"]:
             self._attach(replay=bool(self._shared["obs_log"]))
 
     def _attach(self, replay=False):
-        result = self._client.request(
-            "attach",
-            {
-                "tenant": self._tenant,
-                "algo": self._algo_config,
-                "priors": self._priors,
-                "seed": self._seed,
-                "quotas": self._quotas,
-            },
-            mode=MODE_ALWAYS,
-        )
+        payload = {
+            "tenant": self._tenant,
+            "algo": self._algo_config,
+            "priors": self._priors,
+            "seed": self._seed,
+            "quotas": self._quotas,
+        }
+        if self._takeover:
+            # The ring owner is marked down and this member is the
+            # live-ring fallback: declare the off-ring attach explicitly,
+            # or the member (which may still believe the owner alive)
+            # would answer WrongGateway and the pair would bounce.
+            payload["takeover"] = True
+        result = self._client.request("attach", payload, mode=MODE_ALWAYS)
         self._shared["wants_register"] = bool(result.get("wants_register"))
         behind = int(result.get("n_observed", 0)) < self._logged_observations()
         if replay and (result.get("created") or behind):
@@ -526,6 +623,22 @@ class RemoteAlgorithm(BaseAlgorithm):
         health = self._shared.get("health")
         return dict(health) if health else None
 
+    def placement(self):
+        """The fleet-placement record (None in single-gateway mode): the
+        gateway currently serving this tenant, the membership epoch, and
+        the failover/adoption counters — the producer mirrors these into
+        ``serve.client.*`` gauges so `orion-tpu top` shows where each
+        experiment's tenant lives."""
+        if self._router is None:
+            return None
+        return {
+            "gateway": self._shared.get("gateway"),
+            "epoch": self._router.epoch,
+            "members": len(self._router.addresses),
+            "failovers": self._router.failovers,
+            "adoptions": self._router.adoptions,
+        }
+
     def detach(self):
         """Explicitly release the gateway-side tenant (tests/shutdown)."""
         if self._shared["attached"]:
@@ -547,19 +660,42 @@ def connect_remote_algorithm(
     s, "secret"/"secret_file": ...}) and attach it eagerly so a bad
     address (or refused credential) fails at instantiation, not
     mid-hunt.  The ORION_SERVE_SECRET / ORION_SERVE_SECRET_FILE env vars
-    carry the secret when the config omits it."""
+    carry the secret when the config omits it.
+
+    A multi-member ``addresses`` list (or the ORION_SERVE_ADDRESSES env,
+    comma-separated) builds the FLEET path instead: a
+    :class:`~orion_tpu.serve.fleet.FleetRouter` with one client + one
+    retry policy per member and consistent-hash tenant placement — the
+    tenant attaches on its ring-designated gateway."""
     from orion_tpu.storage.base import resolve_wire_secret
 
-    host, port = parse_address(serve_config.get("address", "127.0.0.1:8777"))
-    client = GatewayClient(
-        host=host,
-        port=port,
-        timeout=float(serve_config.get("timeout", 60.0)),
-        retry=serve_config.get("retry"),
-        secret=resolve_wire_secret(
-            serve_config, env_prefix="ORION_SERVE", what="serve gateway"
-        ),
+    addresses = parse_serve_addresses(serve_config)
+    secret = resolve_wire_secret(
+        serve_config, env_prefix="ORION_SERVE", what="serve gateway"
     )
+    timeout = float(serve_config.get("timeout", 60.0))
+    router = None
+    if len(addresses) > 1:
+        retry = serve_config.get("retry") or dict(FLEET_RETRY_DEFAULTS)
+
+        def factory(address):
+            host, port = parse_address(address)
+            return GatewayClient(
+                host=host, port=port, timeout=timeout, retry=dict(retry),
+                secret=secret,
+            )
+
+        router = FleetRouter(addresses, factory)
+        client = router.client(router.resolve(ring_key(tenant))[0])
+    else:
+        host, port = parse_address(addresses[0])
+        client = GatewayClient(
+            host=host,
+            port=port,
+            timeout=timeout,
+            retry=serve_config.get("retry"),
+            secret=secret,
+        )
     algo = RemoteAlgorithm(
         space,
         priors,
@@ -568,6 +704,7 @@ def connect_remote_algorithm(
         tenant,
         seed=seed,
         quotas=serve_config.get("quotas"),
+        router=router,
     )
     algo._ensure_attached()
     return algo

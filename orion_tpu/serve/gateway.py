@@ -35,6 +35,7 @@ gateway rounds thereby show up in ``orion-tpu top``/``info`` with no
 storage access from the gateway itself.
 """
 
+import base64
 import copy
 import logging
 import os
@@ -58,6 +59,12 @@ from orion_tpu.serve.coalesce import (
     LAST_STACK_PLACEMENT,
     prewarm_stacked,
     run_coalesced_plans,
+)
+from orion_tpu.serve.fleet import (
+    HANDOFF_TTL_S,
+    FleetState,
+    TenantStore,
+    ring_key,
 )
 from orion_tpu.serve.protocol import (
     GATEWAY_OPS,
@@ -97,6 +104,10 @@ class _Tenant:
         self.created_at = time.time()
         self.last_active = time.monotonic()
         self.inflight = 0  # mutated under the gateway lock only
+        # Handoff fence (fleet mode): monotonic fence time while this
+        # tenant's state is in flight to another member.  A fenced tenant
+        # answers RETRY-AFTER — never a second suggestion stream.
+        self.fenced = None
         self.naive_algo = None
         self.naive_epoch = None
         self.reply_cache = OrderedDict()
@@ -134,7 +145,11 @@ class _Tenant:
         rebuilds the algorithm with history, box and RNG stream intact.
         The applied-id ledger rides along — a client replaying its log
         against a restored-but-stale tenant must have the already-
-        snapshotted batches dedup, not double-observe."""
+        snapshotted batches dedup, not double-observe.  So does the
+        suggest reply cache: a client whose reply was lost to the CRASH
+        re-asks the restored tenant with the same req_id and must get the
+        SAME rows back, not a second RNG draw — the fleet failover's
+        bit-identity hinges on it."""
         TSAN.read("GatewayServer.tenant_ledgers", self)
         return {
             "priors": dict(self.priors),
@@ -144,6 +159,7 @@ class _Tenant:
             "max_q": self.max_q,
             "state": self.algo.state_dict(),
             "applied_ids": list(self.applied_order),
+            "reply_cache": list(self.reply_cache.items()),
         }
 
 
@@ -167,6 +183,20 @@ class _WorkItem:
         # request's gateway-side spans and is what the coalesced dispatch
         # span links back to.  Absent/malformed -> None, zero cost.
         self.ctx = TraceContext.from_wire(payload.get("ctx"))
+
+
+def _encode_snapshot(snapshot):
+    """Tenant snapshot -> JSON-safe string for the handoff wire.  Pickle
+    is acceptable HERE because the surface is gateway→gateway inside one
+    authenticated credential domain (the mutual-HMAC handshake gates it)
+    — it is never fed client input."""
+    return base64.b64encode(
+        pickle.dumps(snapshot, protocol=pickle.HIGHEST_PROTOCOL)
+    ).decode("ascii")
+
+
+def _decode_snapshot(encoded):
+    return pickle.loads(base64.b64decode(str(encoded)))
 
 
 #: Sentinel reply meaning "hang up instead of answering": a stopping
@@ -244,6 +274,9 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         persist_interval=5.0,
         metrics_port=None,
         secret=None,
+        fleet=None,
+        advertise=None,
+        handoff_ttl=HANDOFF_TTL_S,
     ):
         # Shared-secret authentication, reusing the netdb wire's PBKDF2
         # key stretch + mutual HMAC handshake.  None = open gateway
@@ -276,11 +309,52 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             "evictions": 0,
             "max_width": 0,
             "widths": {},
+            "handoffs": 0,
+            "handoff_failures": 0,
+            "imports": 0,
+            "wrong_gateway": 0,
         }
+        # --- fleet mode ---------------------------------------------------
+        # ``fleet`` is the member address list (this gateway included,
+        # identified by ``advertise``); placement is the shared hash ring
+        # (fleet.FleetState) every client computes identically.  In fleet
+        # mode ``persist`` is a DIRECTORY of per-tenant snapshots
+        # (TenantStore) and persistence turns SYNCHRONOUS: the round's
+        # dirty tenants are written before the round's replies are
+        # released, so a kill -9 can lose a reply but never an
+        # acknowledged observation or a cached suggest draw.
+        self.handoff_ttl = float(handoff_ttl)
+        self.advertise = None
+        self._fleet = None
+        self._store = None
+        self._moved = OrderedDict()  # tenant -> destination tombstone
+        self._dirty_tenants = set()  # sync-persist worklist (dispatcher)
+        self._deferred = None  # reply-release buffer while sync persisting
+        self._peers = {}  # member address -> GatewayClient (handoff push)
+        if fleet:
+            if advertise is None:
+                raise GatewayError(
+                    "fleet mode needs --advertise (this gateway's own "
+                    "address as the OTHER members and clients dial it)"
+                )
+            self._fleet = FleetState(fleet)
+            try:
+                self.advertise = self._fleet.addresses[
+                    self._fleet.index_of(advertise)
+                ]
+            except ValueError:
+                raise GatewayError(
+                    f"advertise address {advertise!r} is not in the fleet "
+                    f"member list {list(self._fleet.addresses)}"
+                )
+            if persist:
+                self._store = TenantStore(persist)
         # Track label for this gateway's own spans: a distinct Perfetto
         # track even when the gateway runs in-process with its clients.
         self._span_track = f"gateway:{socket.gethostname()}:{os.getpid()}"
-        if persist and os.path.exists(persist):
+        if self._store is not None:
+            self._restore_store()
+        elif persist and os.path.exists(persist):
             self._restore(persist)
         super().__init__((host, int(port)), _Handler)
         # Optional pull-based metrics plane: /metrics (Prometheus text
@@ -346,9 +420,53 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         self._dispatcher.join(timeout=5.0)
         if self._metrics_server is not None:
             self._metrics_server.stop()
+        for peer in self._peers.values():
+            peer.close()
         # Final durable snapshot — same exit discipline as DBServer.
-        if self.persist and self._dirty:
+        if self._store is not None:
+            self._persist_dirty_tenants()
+        elif self.persist and self._dirty:
             self._write_snapshot()
+
+    def kill(self):
+        """Simulated crash (tests/bench): stop serving WITHOUT the final
+        snapshot or any orderly reply drain — in-flight requests see their
+        connections die exactly as a ``kill -9`` would leave them.  What
+        survives is whatever the sync-persist discipline already put on
+        disk, which is precisely the fleet's failover contract."""
+        self._stop.set()
+        super().shutdown()
+        self.server_close()
+        self._dispatcher.join(timeout=5.0)
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+        for peer in self._peers.values():
+            peer.close()
+
+    def _tenant_from_snapshot(self, name, saved):
+        """Rebuild one tenant from a persisted ``state_snapshot()`` — the
+        shared restore path for boot-time snapshots, lazy store restores
+        and handoff imports.  ``set_state`` reinstates history, box AND
+        the RNG stream, so the rebuilt tenant's next draw is the exact
+        draw the snapshotted one would have made."""
+        space = build_space(saved["priors"])
+        algo = create_algo(space, saved["algo_config"], seed=saved.get("seed"))
+        algo.set_state(saved["state"])
+        tenant = _Tenant(
+            name,
+            space,
+            saved["priors"],
+            saved["algo_config"],
+            saved.get("seed"),
+            algo,
+            saved.get("max_inflight", self.max_inflight),
+            saved.get("max_q", self.max_q),
+        )
+        for applied_id in saved.get("applied_ids") or ():
+            tenant.remember_applied(applied_id)
+        for req_id, reply in saved.get("reply_cache") or ():
+            tenant.cache_reply(req_id, reply)
+        return tenant
 
     def _restore(self, path):
         try:
@@ -359,23 +477,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             return
         for name, saved in (snapshot.get("tenants") or {}).items():
             try:
-                space = build_space(saved["priors"])
-                algo = create_algo(
-                    space, saved["algo_config"], seed=saved.get("seed")
-                )
-                algo.set_state(saved["state"])
-                tenant = _Tenant(
-                    name,
-                    space,
-                    saved["priors"],
-                    saved["algo_config"],
-                    saved.get("seed"),
-                    algo,
-                    saved.get("max_inflight", self.max_inflight),
-                    saved.get("max_q", self.max_q),
-                )
-                for applied_id in saved.get("applied_ids") or ():
-                    tenant.remember_applied(applied_id)
+                tenant = self._tenant_from_snapshot(name, saved)
                 # _restore runs from __init__ (pre-thread), but tenant-map
                 # writes stay under the lock everywhere for one invariant.
                 with self._lock:
@@ -387,6 +489,30 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             log.info(
                 "gateway restored %d tenant(s) from %s", len(self._tenants),
                 path,
+            )
+
+    def _restore_store(self):
+        """Boot-time fleet restore: adopt the store's tenants THIS member
+        owns per the ring.  Foreign tenants stay on disk — their owners
+        restore them lazily on first touch, and eagerly adopting them
+        here would fork tenants the rest of the fleet is still serving."""
+        restored = 0
+        for name, saved in self._store.items():
+            if self._fleet.owner(ring_key(name)) != self.advertise:
+                continue
+            try:
+                tenant = self._tenant_from_snapshot(name, saved)
+            except Exception:
+                log.exception("could not restore tenant %r", name)
+                continue
+            with self._lock:
+                TSAN.write("GatewayServer._tenants", self)
+                self._tenants[name] = tenant
+            restored += 1
+        if restored:
+            log.info(
+                "gateway %s restored %d owned tenant(s) from %s",
+                self.advertise, restored, self._store.root,
             )
 
     def _write_snapshot(self):
@@ -409,7 +535,38 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         self._dirty = False
         self._last_persist = time.monotonic()
 
+    def _mark_dirty(self, tenant_name=None):
+        """Persist bookkeeping: the legacy whole-snapshot flag plus the
+        fleet store's per-tenant worklist (the sync-persist set drained
+        before the cycle's replies release)."""
+        self._dirty = True
+        if self._store is not None and tenant_name:
+            self._dirty_tenants.add(tenant_name)
+
+    def _persist_dirty_tenants(self):
+        """Write every dirty tenant's snapshot file (fleet store mode).
+        Runs on the dispatcher between processing a cycle and releasing
+        its replies — the write happening BEFORE the release is the whole
+        durability contract: an acknowledged observation or a delivered
+        suggest draw is always on disk before any client can act on it."""
+        dirty, self._dirty_tenants = self._dirty_tenants, set()
+        if not dirty:
+            return
+        for name in dirty:
+            with self._lock:
+                TSAN.read("GatewayServer._tenants", self)
+                tenant = self._tenants.get(name)
+                snapshot = tenant.state_snapshot() if tenant else None
+            if snapshot is not None:
+                self._store.save(name, snapshot)
+        self._dirty = False
+        self._last_persist = time.monotonic()
+
     def _maybe_persist(self):
+        if self._store is not None:
+            # Fleet mode persists synchronously per cycle; nothing rides
+            # the rate-limited path.
+            return
         if not (self.persist and self._dirty):
             return
         if time.monotonic() - self._last_persist < self.persist_interval:
@@ -430,6 +587,12 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             return ok_reply("pong")
         if op == "stats":
             return ok_reply(self.stats_snapshot())
+        if op == "fleet":
+            # Membership/occupancy probe: answered inline like stats (the
+            # `top --all` header and the router bootstrap read it — a
+            # probe must not queue behind the dispatch backlog it is
+            # trying to measure).
+            return ok_reply(self.fleet_snapshot())
         item = _WorkItem(op, request)
         refused = self._admit(item)
         if refused is not None:
@@ -520,6 +683,11 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                     except queue.Empty:
                         break
             TELEMETRY.set_gauge("serve.queue_depth", self._queue.qsize())
+            if self._store is not None:
+                # Sync-persist cycle: replies computed below are BUFFERED
+                # (``_finish`` parks them on ``_deferred``) and released
+                # only after the dirty tenants' snapshots hit disk.
+                self._deferred = []
             try:
                 self._process(batch)
             except Exception:  # pragma: no cover - per-item paths catch first
@@ -532,7 +700,15 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                                 "GatewayError", "internal dispatch failure"
                             ),
                         )
+            if self._deferred is not None:
+                deferred, self._deferred = self._deferred, None
+                try:
+                    self._persist_dirty_tenants()
+                finally:
+                    for item in deferred:
+                        item.done.set()
             self._maybe_persist()
+            self._publish_fleet_gauges()
         # Stopping: anything still queued gets the hang-up sentinel so its
         # handler closes the connection and the client re-asks elsewhere.
         while True:
@@ -551,7 +727,12 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                     tenant.inflight = max(0, tenant.inflight - 1)
             item.counted = False
         item.reply = reply
-        item.done.set()
+        if self._deferred is not None and reply is not _CLOSE:
+            # Sync-persist deferral: the handler thread stays parked until
+            # the cycle's snapshots are durable (_dispatch_loop releases).
+            self._deferred.append(item)
+        else:
+            item.done.set()
         if TELEMETRY.enabled and item.ctx is not None:
             # The gateway-side half of the request's distributed trace:
             # queue wait + execution, parented at the client's injected
@@ -589,16 +770,24 @@ class GatewayServer(socketserver.ThreadingTCPServer):
     # --- non-suggest ops ------------------------------------------------------
     def _apply(self, item):
         payload = item.payload
+        if item.op == "fleet_set":
+            return self._fleet_set(payload)
+        if item.op == "handoff_import":
+            return self._handoff_import(payload)
         if item.op == "attach":
             return self._attach(payload)
         if item.op == "detach":
             with self._lock:
                 TSAN.write("GatewayServer._tenants", self)
                 self._tenants.pop(item.tenant_name, None)
+                self._moved.pop(item.tenant_name, None)
+            if self._store is not None:
+                self._store.delete(item.tenant_name)
             self._dirty = True
             return ok_reply({"detached": True})
-        TSAN.read("GatewayServer._tenants", self)
-        tenant = self._tenants.get(item.tenant_name)
+        tenant, refusal = self._route(item.tenant_name, payload)
+        if refusal is not None:
+            return refusal
         if tenant is None:
             return error_reply(
                 "UnknownTenant", f"no tenant {item.tenant_name!r} attached"
@@ -610,12 +799,88 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             return self._register(tenant, payload)
         return error_reply("GatewayError", f"bad op {item.op!r}")
 
+    def _wrong_gateway_reply(self, name, owner):
+        """The structured off-ring refusal: carries the authoritative
+        membership + epoch so one bounce teaches the client the fleet."""
+        with self._lock:
+            TSAN.write("GatewayServer.tenant_counters", self)
+            self._stats["wrong_gateway"] += 1
+        TELEMETRY.count("serve.fleet.wrong_gateway")
+        return error_reply(
+            "WrongGateway",
+            f"tenant {name!r} belongs to gateway {owner} "
+            f"(fleet epoch {self._fleet.epoch})",
+            owner=owner,
+            addresses=list(self._fleet.addresses),
+            epoch=self._fleet.epoch,
+        )
+
+    def _restore_tenant_from_store(self, name):
+        """Lazy store restore (fleet mode): first touch of a tenant this
+        member owns whose state a previous owner (or a previous life of
+        this one) persisted.  Returns the installed tenant or None."""
+        if self._store is None:
+            return None
+        saved = self._store.load(name)
+        if saved is None:
+            return None
+        try:
+            tenant = self._tenant_from_snapshot(name, saved)
+        except Exception:
+            log.exception("could not restore tenant %r from store", name)
+            return None
+        with self._lock:
+            TSAN.write("GatewayServer._tenants", self)
+            self._tenants[name] = tenant
+            self._moved.pop(name, None)  # we hold it again: drop the tombstone
+        TELEMETRY.count("serve.fleet.store_restores")
+        log.info(
+            "gateway %s restored tenant %r from the fleet store "
+            "(n_observed=%d)", self.advertise, name, int(tenant.algo.n_observed),
+        )
+        return tenant
+
+    def _route(self, name, payload):
+        """Fleet-aware tenant resolution: ``(tenant, refusal_reply)``.
+
+        The who-wins ladder (see docs/serving.md):
+
+        1. A member HOLDING the tenant serves it whatever the ring says
+           (pinned — the holder's state is the live stream), unless the
+           tenant is fenced mid-handoff (RETRY-AFTER: the state is in
+           flight, answering would fork the stream).
+        2. A moved tombstone, or ring ownership elsewhere, answers
+           ``WrongGateway`` with the authoritative membership — except
+           when the client declared a ``takeover`` (its router marked the
+           ring owner down; refusing would bounce the pair forever).
+        3. Owned-but-absent falls through to the lazy store restore, then
+           to the caller's UnknownTenant / create path."""
+        TSAN.read("GatewayServer._tenants", self)
+        tenant = self._tenants.get(name)
+        if self._fleet is None:
+            return tenant, None
+        if tenant is not None:
+            if tenant.fenced is not None:
+                return None, self._retry_after_reply(
+                    f"tenant {name!r} is fenced for a handoff"
+                )
+            return tenant, None
+        takeover = bool(payload.get("takeover"))
+        dest = self._moved.get(name)
+        if dest is not None and not takeover:
+            return None, self._wrong_gateway_reply(name, dest)
+        owner = self._fleet.owner(ring_key(name))
+        if owner != self.advertise and not takeover:
+            return None, self._wrong_gateway_reply(name, owner)
+        return self._restore_tenant_from_store(name), None
+
     def _attach(self, payload):
         name = str(payload.get("tenant") or "")
         if not name:
             return error_reply("GatewayError", "attach requires a tenant name")
-        TSAN.read("GatewayServer._tenants", self)
-        tenant = self._tenants.get(name)
+        tenant, refusal = self._route(name, payload)
+        if refusal is not None:
+            return refusal
         if tenant is not None:
             tenant.last_active = time.monotonic()
             return ok_reply(
@@ -625,6 +890,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                     "wants_register": tenant.wants_register,
                 }
             )
+        TSAN.read("GatewayServer._tenants", self)
         if len(self._tenants) >= self.max_tenants:
             evicted = self._evict_idle()
             if not evicted:
@@ -653,7 +919,8 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         with self._lock:
             TSAN.write("GatewayServer._tenants", self)
             self._tenants[name] = tenant
-        self._dirty = True
+            self._moved.pop(name, None)
+        self._mark_dirty(name)
         TELEMETRY.count("serve.attaches")
         log.info("gateway attached tenant %r (%s)", name, payload.get("algo"))
         return ok_reply(
@@ -677,6 +944,11 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             victim = min(idle, key=lambda t: t.last_active)
             del self._tenants[victim.name]
             self._stats["evictions"] += 1
+        if self._store is not None:
+            # Fleet mode: write-through before forgetting, so the next
+            # touch lazily restores the full state instead of costing the
+            # client a replay.
+            self._store.save(victim.name, victim.state_snapshot())
         self._dirty = True
         TELEMETRY.count("serve.evictions")
         if FLIGHT.enabled:
@@ -716,7 +988,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             TSAN.write("GatewayServer.tenant_counters", self)
             tenant.observes += 1
             self._stats["observes"] += 1
-        self._dirty = True
+        self._mark_dirty(tenant.name)
         TELEMETRY.count("serve.observes")
         return ok_reply(
             {"applied": True, "n_observed": int(tenant.algo.n_observed)}
@@ -733,8 +1005,218 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             # Ledger writes ride the gateway lock (see _observe).
             with self._lock:
                 tenant.remember_applied(reg_id)
-        self._dirty = True
+        self._mark_dirty(tenant.name)
         return ok_reply({"applied": True})
+
+    # --- fleet membership + handoff ------------------------------------------
+    def fleet_snapshot(self):
+        """The ``fleet`` op payload: membership, epoch, and this member's
+        occupancy — what `top --all` probes once per frame and what a
+        router bootstraps its ring from.  A single (non-fleet) gateway
+        answers a one-member fleet so the probe path never branches."""
+        with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
+            tenants = len(self._tenants)
+            fenced = [t.fenced for t in self._tenants.values()
+                      if t.fenced is not None]
+            moved = len(self._moved)
+        now = time.monotonic()
+        fenced_age = max((now - f for f in fenced), default=0.0)
+        if self._fleet is None:
+            member = f"{self.address[0]}:{self.address[1]}"
+            addresses, epoch = [member], 0
+        else:
+            member = self.advertise
+            addresses = list(self._fleet.addresses)
+            epoch = self._fleet.epoch
+        return {
+            "fleet": self._fleet is not None,
+            "self": member,
+            "addresses": addresses,
+            "epoch": epoch,
+            "tenants": tenants,
+            "queue_depth": self._queue.qsize(),
+            "fenced": len(fenced),
+            "fenced_age_s": round(fenced_age, 3),
+            "moved": moved,
+            "handoffs": self._stats["handoffs"],
+            "handoff_failures": self._stats["handoff_failures"],
+        }
+
+    def _publish_fleet_gauges(self):
+        """The fleet's doctor surface: this member's tenant count under
+        its stable ring index (``serve.fleet.tenants.g{i}`` — DX007 reads
+        the spread) and the oldest fence age (``serve.fleet.fenced_age_s``
+        — DX008's handoff-stuck signal)."""
+        if self._fleet is None or not TELEMETRY.enabled:
+            return
+        with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
+            tenants = len(self._tenants)
+            fenced = [t.fenced for t in self._tenants.values()
+                      if t.fenced is not None]
+        now = time.monotonic()
+        index = self._fleet.index_of(self.advertise)
+        TELEMETRY.set_gauge(f"serve.fleet.tenants.g{index}", float(tenants))
+        TELEMETRY.set_gauge("serve.fleet.members", float(len(self._fleet.addresses)))
+        TELEMETRY.set_gauge("serve.fleet.epoch", float(self._fleet.epoch))
+        TELEMETRY.set_gauge(
+            "serve.fleet.fenced_age_s",
+            round(max((now - f for f in fenced), default=0.0), 3),
+        )
+
+    def _peer_client(self, address):
+        """The gateway→gateway client for handoff pushes: one cached
+        connection per peer, the SAME shared secret (a fleet is one
+        credential domain), and a tight policy — a push that cannot land
+        inside it unfences the tenant and keeps serving locally."""
+        client = self._peers.get(address)
+        if client is None:
+            from orion_tpu.serve.client import GatewayClient, parse_address
+
+            host, port = parse_address(address)
+            client = GatewayClient(
+                host=host, port=port, timeout=30.0, secret=self.secret,
+                retry={"max_attempts": 3, "deadline": 15.0, "base_delay": 0.1},
+            )
+            self._peers[address] = client
+        return client
+
+    def _fleet_set(self, payload):
+        """Operator membership change (`orion-tpu serve` peers / bench):
+        adopt the new epoch, then hand off every held tenant the new ring
+        assigns elsewhere.  Runs on the dispatcher — membership flips and
+        handoffs are serialized against the request stream, so no suggest
+        can interleave with a tenant's fence→export→flip."""
+        if self._fleet is None:
+            return error_reply(
+                "GatewayError",
+                "this gateway was not started in fleet mode (--fleet)",
+            )
+        addresses = payload.get("addresses") or []
+        if not addresses:
+            return error_reply("GatewayError", "fleet_set requires addresses")
+        old_epoch = self._fleet.epoch if self._fleet is not None else 0
+        epoch = int(payload.get("epoch") or old_epoch + 1)
+        if epoch <= old_epoch and self._fleet is not None:
+            return error_reply(
+                "GatewayError",
+                f"fleet_set epoch {epoch} is not newer than {old_epoch}",
+            )
+        fleet = FleetState(addresses, epoch=epoch)
+        leaving = self.advertise not in fleet.addresses
+        self._fleet = fleet
+        with self._lock:
+            TSAN.read("GatewayServer._tenants", self)
+            held = list(self._tenants)
+        moves = []
+        for name in held:
+            owner = fleet.owner(ring_key(name))
+            if leaving or owner != self.advertise:
+                moves.append((name, owner))
+        failed = []
+        for name, owner in moves:
+            if not self._handoff(name, owner):
+                failed.append(name)
+        self._publish_fleet_gauges()
+        log.info(
+            "gateway %s adopted fleet epoch %d (%d member(s), %d handoff(s)"
+            ", %d failed)", self.advertise, epoch, len(fleet.addresses),
+            len(moves), len(failed),
+        )
+        return ok_reply(
+            {
+                "epoch": epoch,
+                "addresses": list(fleet.addresses),
+                "moved": len(moves) - len(failed),
+                "failed": failed,
+                "leaving": leaving,
+            }
+        )
+
+    def _handoff(self, name, destination):
+        """One tenant's pinned→fenced→moved migration (the PR 13 phase
+        discipline on tenant state): fence (RETRY-AFTER, the stream
+        freezes), export the snapshot, push it into the destination, then
+        flip (drop locally, leave a moved-tombstone answering
+        ``WrongGateway``).  Any push failure unfences and keeps serving —
+        the failure mode is a stale placement, never a fork."""
+        with self._lock:
+            TSAN.write("GatewayServer._tenants", self)
+            tenant = self._tenants.get(name)
+            if tenant is None:
+                return True
+            tenant.fenced = time.monotonic()
+        snapshot = tenant.state_snapshot()
+        try:
+            encoded = _encode_snapshot(snapshot)
+            self._peer_client(destination).request(
+                "handoff_import",
+                {"tenant": name, "snapshot": encoded,
+                 "epoch": self._fleet.epoch},
+            )
+        except Exception:
+            log.exception(
+                "handoff of %r to %s failed; unfencing", name, destination
+            )
+            with self._lock:
+                TSAN.write("GatewayServer._tenants", self)
+                if self._tenants.get(name) is tenant:
+                    tenant.fenced = None
+                self._stats["handoff_failures"] += 1
+            TELEMETRY.count("serve.fleet.handoff_failures")
+            return False
+        with self._lock:
+            TSAN.write("GatewayServer._tenants", self)
+            self._tenants.pop(name, None)
+            self._moved[name] = destination
+            while len(self._moved) > APPLIED_IDS_CAP:
+                self._moved.popitem(last=False)
+            self._stats["handoffs"] += 1
+        self._dirty_tenants.discard(name)
+        TELEMETRY.count("serve.fleet.handoffs")
+        if FLIGHT.enabled:
+            FLIGHT.record(
+                "serve.handoff", args={"tenant": name, "to": destination}
+            )
+        log.info("gateway %s handed tenant %r to %s",
+                 self.advertise, name, destination)
+        return True
+
+    def _handoff_import(self, payload):
+        """Destination side of a handoff: rebuild the tenant from the
+        pushed snapshot and make it durable HERE before acking — the
+        source drops its copy on this ack, so the ack must mean 'I can
+        survive my own kill with it'.  An import overwrites any local
+        copy: the source's state is the authoritative stream (a racing
+        fresh attach here was a client ahead of the migration; its
+        observations replay and dedup against the imported ledger)."""
+        name = str(payload.get("tenant") or "")
+        if not name:
+            return error_reply("GatewayError", "handoff_import needs a tenant")
+        try:
+            snapshot = _decode_snapshot(payload.get("snapshot"))
+            tenant = self._tenant_from_snapshot(name, snapshot)
+        except Exception as exc:
+            log.exception("could not import handed-off tenant %r", name)
+            return error_reply(type(exc).__name__, str(exc))
+        if self._store is not None:
+            self._store.save(name, snapshot)
+        with self._lock:
+            TSAN.write("GatewayServer._tenants", self)
+            self._tenants[name] = tenant
+            self._moved.pop(name, None)
+            self._stats["imports"] += 1
+        TELEMETRY.count("serve.fleet.imports")
+        self._publish_fleet_gauges()
+        log.info(
+            "gateway %s imported tenant %r (n_observed=%d)",
+            self.advertise or self.address, name,
+            int(tenant.algo.n_observed),
+        )
+        return ok_reply(
+            {"imported": True, "n_observed": int(tenant.algo.n_observed)}
+        )
 
     # --- suggest execution ----------------------------------------------------
     def _run_suggests(self, items):
@@ -744,8 +1226,10 @@ class GatewayServer(socketserver.ThreadingTCPServer):
         deferred = []  # re-asks of an in-cycle original: answer from cache
         for item in items:
             payload = item.payload
-            TSAN.read("GatewayServer._tenants", self)
-            tenant = self._tenants.get(item.tenant_name)
+            tenant, refusal = self._route(item.tenant_name, payload)
+            if refusal is not None:
+                self._finish(item, refusal)
+                continue
             if tenant is None:
                 self._finish(
                     item,
@@ -989,7 +1473,7 @@ class GatewayServer(socketserver.ThreadingTCPServer):
                 tenant.metric_request,
                 time.perf_counter() - job.item.enqueued_at,
             )
-        self._dirty = True
+        self._mark_dirty(tenant.name)
         self._finish(job.item, reply)
 
     def _health_fields(self, job):
@@ -1044,6 +1528,8 @@ class GatewayServer(socketserver.ThreadingTCPServer):
             )
         else:
             stats["dispatches_per_suggest"] = None
+        if self._fleet is not None:
+            stats["fleet"] = self.fleet_snapshot()
         return stats
 
 

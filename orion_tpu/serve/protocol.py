@@ -33,8 +33,15 @@ from orion_tpu.utils.exceptions import DatabaseError
 
 #: Ops a gateway client may invoke — anything else is rejected (the wire
 #: protocol is not a generic RPC surface; same rule as netdb's _DB_OPS).
+#: The fleet ops: ``fleet`` (membership/occupancy probe — the `top --all`
+#: header and the router's bootstrap), ``fleet_set`` (operator membership
+#: change; triggers handoffs), ``handoff_import`` (gateway→gateway tenant
+#: state transfer during a handoff).
 GATEWAY_OPS = frozenset(
-    {"ping", "stats", "attach", "detach", "suggest", "observe", "register"}
+    {
+        "ping", "stats", "attach", "detach", "suggest", "observe",
+        "register", "fleet", "fleet_set", "handoff_import",
+    }
 )
 
 
@@ -48,6 +55,21 @@ class GatewayError(RuntimeError):
 
 class UnknownTenantError(GatewayError):
     """The gateway has no state for this tenant — re-attach + replay."""
+
+
+class WrongGatewayError(GatewayError):
+    """This tenant belongs to ANOTHER fleet member (the ring says so, or
+    a completed handoff left a moved-tombstone here).  Fatal to the retry
+    policy — re-sending to the wrong member can never converge — and
+    handled one level up: the fleet-aware client adopts the reply's
+    authoritative membership (``addresses`` + ``epoch``) and re-resolves.
+    """
+
+    def __init__(self, message, owner=None, addresses=None, epoch=0):
+        super().__init__(message)
+        self.owner = owner
+        self.addresses = list(addresses or ())
+        self.epoch = int(epoch or 0)
 
 
 class RetryAfterError(DatabaseError):

@@ -282,6 +282,43 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     ), "the serve leg contributed no cross-process flow link"
 
 
+def test_bench_serve_fleet_smoke_leg_survives_member_kill(repo_root):
+    """The 2-gateway fleet twin of the serve leg (ISSUE 19), run
+    IN-PROCESS (the jit compiles amortize with the rest of tier-1): 3
+    tenants ring-routed over 2 fleet members sharing a tenant snapshot
+    store, the busier member killed at the mid-stream round barrier.
+    bench_serve_fleet hard-asserts (SystemExit) bit-identical streams vs
+    the single-gateway reference, zero lost observations on the
+    survivor, fleet-wide dispatches/suggest < 1, and that the kill
+    actually forced a failover; this pins the payload block on top."""
+    sys.path.insert(0, repo_root)
+    try:
+        from bench import bench_serve_fleet
+    finally:
+        sys.path.remove(repo_root)
+
+    block = bench_serve_fleet(
+        m_gateways=2,
+        n_tenants=3,
+        rounds=3,
+        q=4,
+        window=0.2,
+        n_candidates=64,
+        fit_steps=4,
+        priors={f"x{j}": "uniform(0, 1)" for j in range(3)},
+    )
+    assert block["gateways"] == 2 and block["tenants"] == 3
+    assert block["bit_identical"] is True
+    assert block["lost_observations"] == 0
+    assert block["audit_violations"] == 0
+    assert block["dispatches_per_suggest"] < 1.0
+    assert block["failovers"] >= 1
+    assert block["killed"] in block["placement"]
+    # The victim is the busier member by construction, so the kill moved
+    # at least one tenant through the takeover path.
+    assert block["placement"][block["killed"]] >= 1
+
+
 def test_bench_chaos_smoke_reports_retries_and_audits_clean(repo_root):
     """``bench.py --chaos``: the seeded fault schedules fire, the retry
     policy absorbs them (storage.retries > 0 on the faulted sqlite run,

@@ -171,6 +171,46 @@ FIXTURES = {
             now=NOW,
         ),
     ),
+    "DX007": (
+        # 3-member fleet with 9 of 12 tenants on g0 (even share 4, bar at
+        # 2x = 8) — the collapsed-placement pathology.
+        Snapshot(
+            metrics=_metrics(
+                gauges={
+                    "serve.fleet.tenants.g0": 9.0,
+                    "serve.fleet.tenants.g1": 1.0,
+                    "serve.fleet.tenants.g2": 2.0,
+                    "serve.fleet.members": 3.0,
+                }
+            ),
+            now=NOW,
+        ),
+        # Healthy ring: every member near the even share.
+        Snapshot(
+            metrics=_metrics(
+                gauges={
+                    "serve.fleet.tenants.g0": 5.0,
+                    "serve.fleet.tenants.g1": 4.0,
+                    "serve.fleet.tenants.g2": 3.0,
+                    "serve.fleet.members": 3.0,
+                }
+            ),
+            now=NOW,
+        ),
+    ),
+    "DX008": (
+        # A tenant fenced for 2 minutes against the 30s handoff TTL — the
+        # stuck-migration pathology (workers get RETRY-AFTER forever).
+        Snapshot(
+            metrics=_metrics(gauges={"serve.fleet.fenced_age_s": 120.0}),
+            now=NOW,
+        ),
+        # An in-flight handoff moments old is normal.
+        Snapshot(
+            metrics=_metrics(gauges={"serve.fleet.fenced_age_s": 0.4}),
+            now=NOW,
+        ),
+    ),
     "DX020": (
         Snapshot(
             metrics=_metrics(

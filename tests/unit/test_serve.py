@@ -450,6 +450,82 @@ def _remote_at(host, port, tenant, seed):
     )
 
 
+def test_fleet_kill_owner_second_gateway_resumes_bit_identical(tmp_path):
+    """The fleet twin of the persist-restart pin: kill the ring-owner
+    gateway mid-run and the client fails over to the SURVIVING member,
+    which restores the tenant from the shared per-tenant store and
+    continues the EXACT suggestion stream — zero lost observations, no
+    fork, no client-visible divergence from an uninterrupted run."""
+    import socket
+
+    from orion_tpu.serve.client import parse_address
+    from orion_tpu.serve.fleet import FleetRouter, FleetState, ring_key
+
+    rounds = 4
+    reference = _drive(
+        create_algo(build_space(PRIORS), ALGO_CFG, seed=11), rounds
+    )
+
+    def _free_port():
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        return port
+
+    store = str(tmp_path / "fleet-store")
+    ports = (_free_port(), _free_port())
+    members = [f"127.0.0.1:{port}" for port in ports]
+    gateways = [
+        GatewayServer(
+            host="127.0.0.1", port=port, window=0.01, max_width=8,
+            fleet=members, advertise=member, persist=store,
+        )
+        for port, member in zip(ports, members)
+    ]
+    for gw in gateways:
+        gw.serve_background()
+
+    tenant = "fleet-exp"
+    owner = FleetState(members).owner(ring_key(tenant))
+    victim, survivor = (
+        (gateways[0], gateways[1])
+        if owner == members[0]
+        else (gateways[1], gateways[0])
+    )
+
+    retry = {"max_attempts": 6, "deadline": 20.0, "base_delay": 0.05}
+
+    def _factory(address):
+        host, port = parse_address(address)
+        return GatewayClient(
+            host=host, port=port, retry=dict(retry), timeout=20.0
+        )
+
+    router = FleetRouter(members, _factory)
+    client = router.client(router.resolve(ring_key(tenant))[0])
+    algo = RemoteAlgorithm(
+        build_space(PRIORS), PRIORS, ALGO_CFG, client, tenant, seed=11,
+        router=router,
+    )
+    try:
+        streams = _drive(algo, 2)
+        # Simulated crash: no farewell snapshot — durability must come
+        # from the sync persist-before-reply-release path alone.
+        victim.kill()
+        streams += _drive(algo, rounds - 2)
+        assert streams == reference
+        assert router.failovers >= 1
+        per_tenant = survivor.stats_snapshot()["per_tenant"][tenant]
+        # All four rounds landed exactly once: two served by the victim
+        # (restored from its synced store snapshot), two by the survivor.
+        assert per_tenant["n_observed"] == rounds * Q
+    finally:
+        router.close()
+        survivor.shutdown()
+        survivor.server_close()
+
+
 def test_reattach_replays_observation_log(gateway):
     """An evicted/forgotten tenant is rebuilt transparently: the adapter
     re-attaches and replays its client-side observe log, then the original

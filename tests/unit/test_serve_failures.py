@@ -121,6 +121,140 @@ def test_gateway_restart_mid_suggest_registers_exactly_one_batch(tmp_path):
             replacement.server_close()
 
 
+def test_fleet_kill_mid_suggest_fails_over_exactly_once(tmp_path):
+    """The fleet twin of the restart-mid-suggest pin: the owner gateway's
+    suggest reply is eaten by the proxy AND the owner is killed before the
+    re-ask lands.  The router marks the owner down, fails over to the
+    surviving member (takeover attach + replay), and the round converges
+    with EXACTLY one observed batch — bit-identical to an uninterrupted
+    standalone run (the sync persist-before-reply-release path snapshotted
+    the post-suggest state, reply cache included, before the doomed reply
+    ever left the dispatcher)."""
+    import socket
+
+    from orion_tpu.algo.base import create_algo
+    from orion_tpu.serve.client import parse_address
+    from orion_tpu.serve.fleet import FleetRouter, FleetState, ring_key
+
+    def _free_port():
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        return port
+
+    def _drive(algo, rounds):
+        streams = []
+        for _ in range(rounds):
+            params = algo.suggest(Q)
+            streams.append(params)
+            algo.observe(
+                params,
+                [
+                    {"objective": float(sum(v * v for v in p.values()))}
+                    for p in params
+                ],
+            )
+        return streams
+
+    rounds = 2
+    reference = _drive(
+        create_algo(build_space(PRIORS), ALGO_CFG, seed=6), rounds
+    )
+
+    store = str(tmp_path / "fleet-store")
+    ports = (_free_port(), _free_port())
+    members = [f"127.0.0.1:{port}" for port in ports]
+    gateways = [
+        GatewayServer(
+            host="127.0.0.1", port=port, window=0.01, max_width=8,
+            fleet=members, advertise=member, persist=store,
+        )
+        for port, member in zip(ports, members)
+    ]
+    for gw in gateways:
+        gw.serve_background()
+
+    tenant = "fleet-fault-exp"
+    owner = FleetState(members).owner(ring_key(tenant))
+    victim, survivor = (
+        (gateways[0], gateways[1])
+        if owner == members[0]
+        else (gateways[1], gateways[0])
+    )
+    proxy = FaultProxy(*parse_address(owner))
+    proxy_addr = proxy.serve_background()
+
+    class _ProxiedClient(GatewayClient):
+        """Connects through the FaultProxy but reports the ring address,
+        so the router's mark_down() hits the right member."""
+
+        def __init__(self, ring_address, **kw):
+            super().__init__(host=proxy_addr[0], port=proxy_addr[1], **kw)
+            self._ring_address = ring_address
+
+        @property
+        def address(self):
+            return self._ring_address
+
+    def _factory(address):
+        host, port = parse_address(address)
+        if address == owner:
+            # Slow first backoff: the dropped reply breaks the connection
+            # immediately, and the re-ask must NOT race the kill thread
+            # onto the still-alive victim (whose reply cache would answer
+            # without any failover happening).
+            return _ProxiedClient(
+                address,
+                retry={"max_attempts": 3, "deadline": 6.0,
+                       "base_delay": 0.75, "max_delay": 1.0},
+                timeout=20.0,
+            )
+        return GatewayClient(
+            host=host, port=port, timeout=20.0,
+            retry={"max_attempts": 4, "deadline": 10.0, "base_delay": 0.05},
+        )
+
+    router = FleetRouter(members, _factory)
+    client = router.client(router.resolve(ring_key(tenant))[0])
+    algo = RemoteAlgorithm(
+        build_space(PRIORS), PRIORS, ALGO_CFG, client, tenant, seed=6,
+        router=router,
+    )
+    try:
+        streams = _drive(algo, 1)  # clean round: replay material
+
+        killed = threading.Event()
+
+        def kill_when_fired():
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if proxy.faults_fired.get("drop_reply"):
+                    break
+                time.sleep(0.005)
+            victim.kill()
+            killed.set()
+
+        killer = threading.Thread(target=kill_when_fired, daemon=True)
+        killer.start()
+        proxy.fail_next("drop_reply")
+        streams += _drive(algo, 1)
+        killer.join(timeout=60)
+        assert killed.is_set(), "kill thread never saw the fault fire"
+        assert proxy.faults_fired.get("drop_reply") == 1
+        assert streams == reference
+        assert router.failovers >= 1
+        per_tenant = survivor.stats_snapshot()["per_tenant"][tenant]
+        # EXACTLY one batch per round: the eaten reply's round was NOT
+        # double-observed by the re-ask on the survivor.
+        assert per_tenant["n_observed"] == rounds * Q
+    finally:
+        proxy.stop()
+        router.close()
+        survivor.shutdown()
+        survivor.server_close()
+
+
 def test_observe_reply_lost_resend_converges(tmp_path):
     server = GatewayServer(window=0.01)
     host, port = server.address
