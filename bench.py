@@ -1337,6 +1337,17 @@ def bench_history_record(payload, now=None):
         # memory analysis is unknowable), present always.
         "sharded_hbm_bound_q": sharded.get("hbm_bound_q"),
         "sharded_hbm_headroom": sharded.get("hbm_headroom"),
+        # Day-2 storage columns (ISSUE 20): the drained fraction of the
+        # keyspace the drain leg moved, and the quorum leg's lost count
+        # (ZERO by construction — trending a nonzero here is the alarm).
+        # None on runs without the soak legs; smoke pins them non-null.
+        "soak_drained_frac": (
+            ((payload.get("drain_soak") or {}).get("drain") or {})
+            .get("planned") or {}
+        ).get("move_fraction"),
+        "soak_quorum_lost": (payload.get("quorum_soak") or {}).get(
+            "lost_observations"
+        ),
     }
 
 
@@ -1854,19 +1865,169 @@ def run_rebalance_soak(n_workers=200, n_experiments=16, trials_per_worker=3,
     return summary
 
 
+def run_drain_soak(n_workers=200, n_experiments=16, trials_per_worker=3,
+                   n_routers=8, deadline=300.0):
+    """The drain-mid-soak leg (ISSUE 20): at the worker barrier the
+    busiest shard is DRAINED — every resident experiment migrated to its
+    post-removal ring home by the crash-resumable migrator
+    (storage/drain.py), zero residents verified — then removed from every
+    live router's topology and stopped; the workers resume and finish on
+    the shrunk ring.  Hard gates: >= 1 experiment moved, the moved
+    fraction within 2x of the drained shard's ring share, ZERO residents
+    left, zero lost observations, clean audits on every surviving
+    shard."""
+    import tempfile
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.storage.soak import (
+        SoakTopology,
+        drain_and_remove,
+        drive_soak,
+    )
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    outcome = {}
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-drain-") as tmpdir:
+            topo = SoakTopology(n_shards=3, replicas=1, persist_dir=tmpdir)
+
+            def drain_hook(storages):
+                outcome.update(drain_and_remove(topo, storages))
+
+            try:
+                result = drive_soak(
+                    topo,
+                    n_workers=n_workers,
+                    n_experiments=n_experiments,
+                    trials_per_worker=trials_per_worker,
+                    n_routers=n_routers,
+                    chaos=False,
+                    mid_hook=drain_hook,
+                    deadline=deadline,
+                )
+            finally:
+                topo.stop()
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    summary = result.summary()
+    summary["drain"] = outcome
+    if not outcome.get("executed"):
+        raise SystemExit(f"drain never executed: {summary}")
+    planned = outcome["planned"]
+    if planned["moves"] < 1:
+        raise SystemExit(f"drain moved NOTHING: {summary}")
+    share = outcome["ring_share"]
+    if planned["move_fraction"] > 2.0 * share:
+        raise SystemExit(
+            f"drain moved {planned['move_fraction']:.1%} of the experiments "
+            f"vs a {share:.1%} ring share (over the 2x bound): {summary}"
+        )
+    if outcome.get("residual"):
+        raise SystemExit(
+            f"drained shard still holds {outcome['residual']} "
+            f"experiment(s): {summary}"
+        )
+    if result.lost_observations != 0:
+        raise SystemExit(f"drain soak LOST observations: {summary}")
+    if not result.audits_clean:
+        raise SystemExit(f"drain soak audits dirty: {summary}")
+    if sum(result.completed_per_shard.values()) != result.completed:
+        raise SystemExit(f"router view != sum of shards: {summary}")
+    return summary
+
+
+def run_quorum_soak(n_workers=200, n_experiments=16, trials_per_worker=3,
+                    n_routers=8, deadline=300.0):
+    """The quorum kill -9 leg (ISSUE 20): a 3-shard x 2-replica topology
+    serving with a quorum floor of 1 — synchronous collections
+    (experiments/trials/placement) acknowledge only after a replica holds
+    the write — takes a PERMANENT primary kill on the busiest shard with
+    **no replica catch-up wait** (``wait_catchup=False`` — the exact wait
+    the async contract needed to be lossless before this PR).  Routers
+    elect the max-seq replica; because every acknowledged sync write was
+    replica-acked first, the winner holds all of them: zero lost BY
+    CONSTRUCTION, which is the hard gate."""
+    import tempfile
+
+    from orion_tpu import telemetry as tel
+    from orion_tpu.storage.soak import (
+        SoakTopology,
+        busiest_shard,
+        drive_soak,
+    )
+
+    was_enabled = tel.TELEMETRY.enabled
+    tel.TELEMETRY.enable()
+    try:
+        with tempfile.TemporaryDirectory(prefix="orion-bench-quorum-") as tmpdir:
+            # replicas=2 is load-bearing with quorum=1: promotion removes
+            # one member from the replica set (the winner) and the old
+            # primary is dead — the ONE remaining replica is what keeps
+            # the promoted primary's sync writes able to meet the floor.
+            topo = SoakTopology(
+                n_shards=3, replicas=2, persist_dir=tmpdir, quorum=1
+            )
+
+            def kill_once(storages):
+                victim = busiest_shard(topo, storages[0].db, n_experiments)
+                topo.shards[victim].kill_primary(wait_catchup=False)
+
+            try:
+                result = drive_soak(
+                    topo,
+                    n_workers=n_workers,
+                    n_experiments=n_experiments,
+                    trials_per_worker=trials_per_worker,
+                    n_routers=n_routers,
+                    chaos=False,
+                    mid_hook=kill_once,
+                    deadline=deadline,
+                )
+            finally:
+                topo.stop()
+    finally:
+        if not was_enabled:
+            tel.TELEMETRY.disable()
+    summary = result.summary()
+    summary["quorum"] = 1
+    summary["wait_catchup"] = False
+    if result.primary_kills != 1:
+        raise SystemExit(f"quorum soak never killed a primary: {summary}")
+    if result.promotions < 1:
+        raise SystemExit(
+            f"primary killed but NO automatic promotion happened: {summary}"
+        )
+    if result.lost_observations != 0:
+        raise SystemExit(
+            f"quorum soak LOST observations despite the ack floor: {summary}"
+        )
+    if not result.audits_clean:
+        raise SystemExit(f"quorum soak audits dirty: {summary}")
+    if sum(result.completed_per_shard.values()) != result.completed:
+        raise SystemExit(f"router view != sum of shards: {summary}")
+    return summary
+
+
 def main_soak(n_workers=1000):
     """``bench.py --soak [--workers N]``: the 1000-worker headline run +
-    the rebalance-mid-soak leg."""
+    the rebalance-, drain- and quorum-mid-soak legs."""
     summary = run_soak(n_workers=n_workers)
     rebalance = run_rebalance_soak(n_workers=min(200, n_workers))
+    drain = run_drain_soak(n_workers=min(200, n_workers))
+    quorum = run_quorum_soak(n_workers=min(200, n_workers))
     payload = {
         "metric": (
             f"sharded soak: {n_workers} workers, 3 shards x 2 replicas, "
             "storms+partition+restart+kill-primary(promotion)+rebalance"
+            "+drain+quorum-kill"
         ),
         "n_workers": n_workers,
         "soak": summary,
         "rebalance_soak": rebalance,
+        "drain_soak": drain,
+        "quorum_soak": quorum,
     }
     print(json.dumps(payload))
 
@@ -2246,6 +2407,20 @@ def main_smoke(trace_out="bench_trace.json"):
         n_workers=8, n_experiments=8, trials_per_worker=4, n_routers=2,
         deadline=120.0,
     )
+    # Tiny drain-mid-soak leg (ISSUE 20): the busiest shard is emptied by
+    # the crash-resumable migrator and removed mid-run — zero residents,
+    # zero lost, moved fraction within 2x of its ring share.
+    drain_block = run_drain_soak(
+        n_workers=8, n_experiments=8, trials_per_worker=4, n_routers=2,
+        deadline=120.0,
+    )
+    # Tiny quorum kill -9 leg (ISSUE 20): 2 replicas under a quorum floor
+    # of 1, permanent busiest-primary kill with NO replica catch-up wait —
+    # zero lost by construction.
+    quorum_block = run_quorum_soak(
+        n_workers=8, n_experiments=4, trials_per_worker=4, n_routers=2,
+        deadline=120.0,
+    )
     trace_file, host_attribution = _safe_trace(trace_out)
     # Smoke's round decomposition: the breakdown's wait_transfer stage IS
     # the measured device window (execution + result transfer), and the
@@ -2295,6 +2470,8 @@ def main_smoke(trace_out="bench_trace.json"):
     payload["serve"] = serve_block
     payload["soak"] = soak_block
     payload["rebalance_soak"] = rebalance_block
+    payload["drain_soak"] = drain_block
+    payload["quorum_soak"] = quorum_block
     payload["doctor"] = doctor_report.summary()
     payload["doctor_critical"] = doctor_report.count("critical")
     # Sharded leg (ISSUE 16): the multichip suggest path under the 8-way
@@ -2326,6 +2503,14 @@ def main_smoke(trace_out="bench_trace.json"):
             "compile_ms_total", "retraces_attributed", "plan_hbm_bytes_max"
         )
         if k not in record
+    ]
+    # Day-2 soak columns: hard non-null (`is None`, not truthiness — the
+    # quorum leg's lost count is LEGITIMATELY 0): a smoke run just ran
+    # both legs, so a None here means the record builder lost the wiring.
+    missing += [
+        k
+        for k in ("soak_drained_frac", "soak_quorum_lost")
+        if record.get(k) is None
     ]
     if missing:
         # Not an assert: the gate must hold under `python -O` too.

@@ -68,6 +68,18 @@ def add_subparser(subparsers):
         "replication sequence on read replies so clients detect lag; also "
         "set automatically when a primary's stream arrives)",
     )
+    serve_p.add_argument(
+        "--quorum",
+        type=int,
+        default=0,
+        metavar="N",
+        help="replication-ack floor for synchronous collections "
+        "(experiments/trials/placement): a write is acknowledged only "
+        "after N replicas confirm it, so those writes survive kill -9 by "
+        "construction.  Needs at least N live replicas to stay writable; "
+        "telemetry/health stay async.  0 (default) = all-async "
+        "(see docs/multi_node.md).",
+    )
     serve_p.set_defaults(func=main_serve)
 
     ring_p = sub.add_parser(
@@ -104,6 +116,44 @@ def add_subparser(subparsers):
         "fence before documents move)",
     )
     rebalance_p.set_defaults(func=main_rebalance)
+
+    drain_p = sub.add_parser(
+        "drain",
+        help="empty one shard BEFORE removing it from the topology: every "
+        "resident experiment migrates to its post-removal ring home "
+        "through the same crash-resumable pin/copy/byte-verify/flip "
+        "machinery as `db rebalance` — zero lost observations, clean "
+        "audit (see docs/multi_node.md, Day-2 operations)",
+    )
+    _common(drain_p)
+    drain_p.add_argument(
+        "shard", metavar="SHARD",
+        help="the shard to drain: its index (as shown by `db ring` / "
+        "`db status`) or its ring identity host:port",
+    )
+    drain_p.add_argument(
+        "--dry-run", action="store_true",
+        help="print the plan and exit without moving anything",
+    )
+    drain_p.add_argument(
+        "--fence-grace", type=float, default=None, metavar="SECONDS",
+        help="how long experiments stay fenced before the flip (default: "
+        "the routers' placement-cache TTL, so every router observes the "
+        "fence before documents move)",
+    )
+    drain_p.set_defaults(func=main_drain)
+
+    status_p = sub.add_parser(
+        "status",
+        help="one-shot storage fleet status: per-shard role, replica set, "
+        "replication lag and quorum floor (probed live)",
+    )
+    _common(status_p)
+    status_p.add_argument(
+        "--json", action="store_true",
+        help="emit the probed structure as JSON instead of the table",
+    )
+    status_p.set_defaults(func=main_status)
 
     migrate_ids_p = sub.add_parser(
         "migrate-ids",
@@ -741,6 +791,7 @@ def main_serve(args):
         secret=secret,
         replicate_to=args.replicate_to,
         replica=args.replica,
+        quorum=args.quorum,
     )
     return 0
 
@@ -845,6 +896,144 @@ def main_rebalance(args):
     rebalancer.run(plan)
     moved = len(plan.moves)
     print(f"rebalanced {moved} experiment(s); placement == ring again")
+    return 0
+
+
+def _resolve_shard_arg(router, value):
+    """A ``db drain`` SHARD operand: an index or a ring identity."""
+    try:
+        index = int(value)
+    except ValueError:
+        index = None
+        for shard in router.describe_topology()["shards"]:
+            if value in (shard["address"], shard["primary"]):
+                index = shard["index"]
+                break
+    return index
+
+
+def main_drain(args):
+    """`db drain SHARD`: run the ring diff BEFORE the shard disappears —
+    migrate every resident experiment to its post-removal ring home
+    (storage/drain.py), verify the shard is empty, then tell the operator
+    to drop it from the shards: stanza.  Re-run after any crash: the plan
+    is recomputed from the standing placement docs and resumes."""
+    import sys
+
+    from orion_tpu.storage.drain import Drainer
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    _storage, router = _sharded_router_or_error(args)
+    if router is None:
+        return 1
+    index = _resolve_shard_arg(router, args.shard)
+    if index is None:
+        print(
+            f"ERROR: no shard matches {args.shard!r} — pass an index or a "
+            "ring identity from `db status`",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        drainer = Drainer(router, index, fence_grace=args.fence_grace)
+    except DatabaseError as exc:
+        print(f"ERROR: {exc}", file=sys.stderr)
+        return 1
+    plan = drainer.plan()
+    print(
+        f"drain shard {index} ({drainer.drain_identity}): "
+        f"{len(plan.moves)} experiment(s) to move "
+        f"(ring share {drainer.ring_share():.1%})"
+    )
+    for move in plan.moves:
+        print(f"  {move.describe()}")
+    for exp_id, homes in plan.strays:
+        print(f"  STRAY {exp_id}: needs `db rebalance` first (shards {homes})")
+    if args.dry_run:
+        return 1 if plan.strays else 0
+    if plan.strays:
+        print(
+            "ERROR: strays present — run `orion-tpu db rebalance` first, "
+            "then drain",
+            file=sys.stderr,
+        )
+        return 1
+    try:
+        drainer.run(plan)
+    except DatabaseError as exc:
+        print(f"ERROR: drain failed: {exc}", file=sys.stderr)
+        print(f"re-run `orion-tpu db drain {args.shard}` to resume", file=sys.stderr)
+        return 1
+    residual = drainer.residual_experiments()
+    if residual:
+        print(
+            f"ERROR: {len(residual)} experiment(s) still resident after the "
+            f"drain: {residual[:3]} — re-run to resume",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"shard {index} drained: {len(plan.moves)} experiment(s) moved, "
+        "0 resident"
+    )
+    print(
+        f"now remove {drainer.drain_identity} from the storage shards: "
+        "stanza (every router picks the new ring up via set_topology / "
+        "restart) and retire the server"
+    )
+    return 0
+
+
+def main_status(args):
+    """`db status`: the storage fleet at a glance — one probed line per
+    shard (role, epoch, seq, quorum floor, per-replica lag), same
+    rendering discipline as the `top --all` fleet header."""
+    import json
+
+    from orion_tpu.cli.base import describe_storage_topology
+
+    _storage, router = _sharded_router_or_error(args)
+    if router is None:
+        return 1
+    topology = router.describe_topology()
+    health = router.replication_health()
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "vnodes": topology["vnodes"],
+                    "replica_reads": topology["replica_reads"],
+                    "shards": health,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+        return 0
+    print(describe_storage_topology(probe=True))
+    for entry in health:
+        if entry.get("error"):
+            print(
+                f"  s{entry['index']} {entry['address']}  "
+                f"DOWN ({entry['error']})"
+            )
+            continue
+        quorum = entry.get("quorum", 0)
+        line = (
+            f"  s{entry['index']} {entry['address']}  "
+            f"{entry.get('role', '?')}@{entry['primary']}  "
+            f"epoch {entry.get('epoch', 0)}  seq {entry.get('seq', 0)}  "
+            f"quorum {quorum if quorum else 'off'}"
+        )
+        print(line)
+        for row in entry.get("replicas", ()):
+            if row.get("error"):
+                detail = f"DOWN ({row['error']})"
+            else:
+                detail = f"seq {row.get('seq', 0)}  lag {row.get('lag', '?')}"
+                if row.get("resyncing"):
+                    detail += "  RESYNCING"
+            print(f"      replica {row['address']}  {detail}")
     return 0
 
 

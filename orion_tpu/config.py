@@ -99,6 +99,12 @@ DEFAULTS = {
     # on experiment id, read-replica fan-out) — plus the router knobs
     # `vnodes`, `replica_reads`, `shard_retry`, `reconnect_jitter`.  The
     # ORION_DB_SHARDS env var carries the replica-less spelling.
+    # storage.quorum is the SERVER-side replication-ack floor (`db serve
+    # --quorum N`, docs/multi_node.md "Day-2 operations"): synchronous
+    # collections (experiments/trials/placement) acknowledge a write only
+    # after N replicas confirmed it — zero-loss under kill -9 by
+    # construction; telemetry/health stay async.  Needs >= N live replicas
+    # to stay writable; pair with replica auto-reprovisioning.
     "storage": {"type": "pickled", "path": "orion_tpu_db.pkl", "retry": {}},
     # Framework telemetry (orion_tpu.telemetry): None = leave the
     # process-wide registry as the ORION_TPU_TELEMETRY env var set it;

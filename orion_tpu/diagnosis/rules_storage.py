@@ -195,6 +195,72 @@ class DegradedShard(DoctorRule):
                 )
 
 
+class DrainStuck(DoctorRule):
+    id = "DX060"
+    name = "drain-stuck"
+    severity = "warn"
+    runbook = "dx060-drain-stuck"
+    description = (
+        "a `db drain` phase has made no progress for minutes "
+        "(storage.drain.phase_age_s): the migrator is wedged on a dead "
+        "destination, an endless retry loop, or a crashed operator "
+        "session that left experiments pinned/fenced."
+    )
+
+    #: A healthy drain progresses per move in well under this; a fenced
+    #: experiment stuck past it is blocking writes.
+    MAX_PHASE_AGE_S = 120.0
+
+    def evaluate(self, snapshot):
+        age = snapshot.gauge("storage.drain.phase_age_s")
+        if age is not None and age >= self.MAX_PHASE_AGE_S:
+            yield self.finding(
+                f"drain phase stalled for {age:.0f}s (>= "
+                f"{self.MAX_PHASE_AGE_S:g}s) — fenced experiments refuse "
+                "writes until it finishes; re-run `orion-tpu db drain` "
+                "(crash-resumable) or check the destination shard",
+                value=age,
+            )
+
+
+class ReplicaShort(DoctorRule):
+    id = "DX061"
+    name = "replica-short"
+    severity = "warn"
+    runbook = "dx061-replica-short"
+    description = (
+        "a promoted primary is running below its declared replica count "
+        "with no reprovision in flight: the shard's failover capital is "
+        "gone — the next primary loss has no caught-up replica to elect "
+        "(and a quorum floor would refuse writes outright)."
+    )
+
+    def evaluate(self, snapshot):
+        if (snapshot.gauge("storage.reprovision.in_progress", 0.0) or 0.0) > 0:
+            return  # repair underway — the gauge drops when it lands
+        for entry in snapshot.replication or ():
+            if entry.get("error"):
+                continue  # a dead PRIMARY is DX025's finding
+            if int(entry.get("epoch", 0) or 0) <= 0:
+                continue  # never promoted: a down replica reboots as itself
+            dead = [
+                row.get("address")
+                for row in entry.get("replicas", ())
+                if row.get("error")
+            ]
+            if dead:
+                yield self.finding(
+                    f"shard {entry.get('index')} promoted primary "
+                    f"{entry.get('primary')} is short {len(dead)} "
+                    f"replica(s) ({', '.join(map(str, dead))}) with no "
+                    "reprovision in flight — configure a "
+                    "replica_provisioner or start/adopt a replacement "
+                    "manually",
+                    value=len(dead),
+                    subject=entry.get("index"),
+                )
+
+
 STORAGE_RULES = (
     StorageRetrySpike,
     StorageGaveUp,
@@ -202,4 +268,6 @@ STORAGE_RULES = (
     ReplicationLagGrowth,
     FencedWriteSpike,
     DegradedShard,
+    DrainStuck,
+    ReplicaShort,
 )

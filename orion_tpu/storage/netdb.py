@@ -92,11 +92,33 @@ _MUTATING_OPS = frozenset(
 # Server-level ops outside the document contract: the replication stream a
 # primary pushes to its read replicas, the applied-sequence probe the
 # pushers (and operators) use to measure replica lag, the promotion op a
-# router's election sends to the most-caught-up replica, and the
+# router's election sends to the most-caught-up replica, the
+# replica-adoption op auto-reprovisioning sends to a short primary, and the
 # consistent-snapshot export behind `orion-tpu db backup`.  All require
 # authentication — the replication stream is a full write channel, and
-# promotion/snapshot reshape or export the whole store.
-_SERVER_OPS = frozenset({"replicate", "seq", "promote", "snapshot"})
+# promotion/adoption/snapshot reshape or export the whole store.
+_SERVER_OPS = frozenset({"replicate", "seq", "promote", "adopt_replica", "snapshot"})
+
+# Collections whose writes are SYNC under quorum mode (`storage.quorum`):
+# the registration ground truth whose loss the async replication contract
+# would otherwise permit on a kill -9 of the primary.  Telemetry, metrics,
+# spans and health stay async — they are observability volume, re-emitted
+# or tolerably lossy by contract, and gating them on replica acks would put
+# the whole heartbeat path behind the slowest replica.
+SYNC_COLLECTIONS = frozenset(
+    {"experiments", "trials", "lying_trials", "_placement"}
+)
+
+# Mutating ops (wire AND batch sub-ops) whose first positional argument
+# names the collection — the quorum gate classifies sync vs async through
+# it.  Index management carries no collection data worth gating: its
+# replay converges identically either way.
+_COLLECTION_MUTATORS = frozenset({"write", "read_and_write", "remove"})
+
+
+def _quorum_sync(op, args):
+    """True when ``op(args...)`` mutates a SYNC collection (quorum-gated)."""
+    return op in _COLLECTION_MUTATORS and bool(args) and args[0] in SYNC_COLLECTIONS
 
 #: Bounded primary-side replication log (ops, not bytes).  A replica that
 #: falls further behind than this gets a full snapshot resync instead of an
@@ -309,15 +331,15 @@ class _Handler(socketserver.StreamRequestHandler):
             return {"ok": True, "result": self.server.seq_info()}
         if op == "snapshot":
             return {"ok": True, "result": self.server.snapshot_payload()}
-        if op in ("replicate", "promote"):
+        if op in ("replicate", "promote", "adopt_replica"):
             try:
                 args = request.get("args") or []
                 payload = args[0] if args else None
-                handler = (
-                    self.server.handle_replicate
-                    if op == "replicate"
-                    else self.server.handle_promote
-                )
+                handler = {
+                    "replicate": self.server.handle_replicate,
+                    "promote": self.server.handle_promote,
+                    "adopt_replica": self.server.handle_adopt_replica,
+                }[op]
                 return {"ok": True, "result": handler(payload)}
             except Exception as exc:  # pragma: no cover - defensive
                 log.exception("%s failed", op)
@@ -345,6 +367,8 @@ class _Handler(socketserver.StreamRequestHandler):
             if op in _MUTATING_OPS:
                 result, seq = self.server.apply_replicated(op, args, kwargs, method)
                 self.server.persist_snapshot()
+                if _quorum_sync(op, args) and not self.server.await_quorum(seq):
+                    return self.server.quorum_timeout_reply(op, seq)
                 out = {"ok": True, "result": result}
             else:
                 # A read replica stamps its applied replication sequence on
@@ -422,6 +446,11 @@ class _Handler(socketserver.StreamRequestHandler):
             results, seq = self.server.apply_batch_replicated(db, normalized)
             if mutating:
                 self.server.persist_snapshot()
+                if any(
+                    _quorum_sync(op, sub_args)
+                    for op, sub_args, _ in normalized
+                ) and not self.server.await_quorum(seq):
+                    return self.server.quorum_timeout_reply("batch", seq)
             else:
                 seq = pre_stamp
             out = {"ok": True, "result": [_encode_outcome(r) for r in results]}
@@ -563,6 +592,7 @@ class _ReplicaLink:
                     self.server.demote(peer_epoch)
                     return
                 self.acked_seq = int(info.get("seq", 0))
+                self.server.ack_notify()
             with self.server._repl_lock:
                 entries = [
                     list(e) for e in self.server._repl_log
@@ -604,6 +634,7 @@ class _ReplicaLink:
                     return
                 self.force_resync = False
                 self.acked_seq = int(result.get("seq", 0))
+                self.server.ack_notify()
                 continue
             if not entries:
                 return
@@ -618,6 +649,7 @@ class _ReplicaLink:
                 self.server.demote(int(result.get("epoch", 0) or 0))
                 return
             self.acked_seq = int(result.get("seq", 0))
+            self.server.ack_notify()
             if result.get("resync"):
                 # The replica saw a sequence gap (or an epoch change /
                 # fork) mid-chunk; ship a snapshot next cycle — the log
@@ -642,7 +674,17 @@ class DBServer(socketserver.ThreadingTCPServer):
     stream in order and stamps its APPLIED seq on read replies — which is
     what lets :class:`~orion_tpu.storage.shard.ShardedNetworkDB` detect a
     lagging replica and fail a read over to the primary.  Replication is
-    asynchronous: writes are acknowledged before they reach any replica."""
+    asynchronous by default: writes are acknowledged before they reach any
+    replica.  **Quorum mode** (``quorum=N``, `storage.quorum`) tightens the
+    contract for the registration collections (:data:`SYNC_COLLECTIONS`):
+    a mutating reply waits until at least N replica links have acknowledged
+    the write's sequence — the log is ordered, so a replica acking seq S
+    holds every write ≤ S, which is exactly why a max-seq election winner
+    carries every quorum-acked write and a kill -9 loses nothing sync by
+    construction.  An ack that never comes within ``quorum_timeout`` fails
+    the reply with ``maybe_applied`` (the write DID apply locally; the
+    retry layer's MODE_UNAPPLIED ops give up, MODE_ALWAYS ops converge
+    through their duplicate-key/absolute-id discipline)."""
 
     allow_reuse_address = True
     daemon_threads = True
@@ -664,9 +706,17 @@ class DBServer(socketserver.ThreadingTCPServer):
         secret=None,
         replicate_to=None,
         replica=False,
+        quorum=0,
+        quorum_timeout=2.0,
     ):
         self.persist = persist
         self.persist_interval = persist_interval
+        #: Per-write replication-ack floor for SYNC_COLLECTIONS mutations
+        #: (0 = classic async replication).  Configured on every server of
+        #: a shard — replicas carry it dormant so a promoted one enforces
+        #: the same contract its predecessor did.
+        self.quorum = int(quorum or 0)
+        self.quorum_timeout = float(quorum_timeout)
         # Server-side span recording rides a PRIVATE registry, not the
         # process-global one: an in-process loopback server sharing the
         # global ring would have its spans drained (exactly-once) by
@@ -713,6 +763,10 @@ class DBServer(socketserver.ThreadingTCPServer):
         # apply_replicated uses, and a snapshot resync applies indexes via
         # the same db surface.
         self._repl_lock = threading.RLock()
+        #: Pusher threads notify here whenever a replica's acked position
+        #: advances; the quorum gate waits on it.  Sharing _repl_lock means
+        #: the ack predicate is always read consistently with the link set.
+        self._ack_cond = threading.Condition(self._repl_lock)
         self._is_replica = bool(replica)
         self._repl_log = deque(maxlen=REPL_LOG_CAP)
         self._repl_links = []
@@ -992,6 +1046,110 @@ class DBServer(socketserver.ThreadingTCPServer):
         self.persist_snapshot()
         return {"promoted": True, "primary": True, "epoch": new_epoch, "seq": seq}
 
+    def handle_adopt_replica(self, payload):
+        """The ``adopt_replica`` wire op: start pushing this primary's
+        stream to a freshly provisioned replica (auto-reprovisioning,
+        ``storage/shard.py``).  Idempotent: an address already linked (or
+        this server's own) reports ``existing`` instead of double-pushing.
+        A replica refuses — adoption reshapes the replication fan-out and
+        only the shard's current primary owns that."""
+        payload = payload or {}
+        addr = payload.get("address")
+        if not addr:
+            raise DatabaseError("adopt_replica needs an 'address'")
+        parsed = _parse_addr(addr)
+        with self._repl_lock:
+            if self._is_replica:
+                return {
+                    "adopted": False,
+                    "primary": False,
+                    "epoch": self.epoch,
+                }
+            known = {(link.host, link.port) for link in self._repl_links}
+            if parsed in known or parsed == tuple(self.address):
+                return {"adopted": True, "existing": True, "epoch": self.epoch}
+            if self.epoch == 0:
+                # Adopting a replica makes this server a replicating
+                # primary; it must stamp a concrete epoch from here on
+                # (same floor a replicate_to construction applies).
+                self.epoch = 1  # lint: disable=LCK002 -- under _repl_lock
+                self._persist_seq_locked()
+            self._was_primary = True
+            link = _ReplicaLink(self, parsed, secret=self.secret)
+            self._repl_links.append(link)
+            epoch = self.epoch
+        # Outside the lock: the empty (or stale) replica snapshot-resyncs
+        # through the pusher's ordinary gap logic — bounded by _resync_gate
+        # like any replica restart.
+        link.start()
+        link.notify()
+        TELEMETRY.count("netdb.adoptions")
+        log.warning(
+            "ADOPTED replica %s:%s at epoch %d (reprovision)", *parsed, epoch
+        )
+        return {"adopted": True, "existing": False, "epoch": epoch}
+
+    # --- quorum gate (storage.quorum) ----------------------------------------
+    def ack_notify(self):
+        """A pusher advanced a replica's acked position: wake quorum waits."""
+        with self._ack_cond:
+            self._ack_cond.notify_all()
+
+    def await_quorum(self, seq, timeout=None):
+        """Block until at least ``quorum`` replica links acknowledge
+        ``seq`` (or every link has, when fewer links than the floor
+        exist — a shard mid-reprovision must not refuse all writes for
+        asking more acks than replicas).  True on success, False on
+        timeout.  Vacuously true with quorum off, no seq, or no links.
+        Books the wait as the ``storage.quorum.wait`` histogram."""
+        if self.quorum <= 0 or seq is None:
+            return True
+        timeout = self.quorum_timeout if timeout is None else timeout
+        t0 = time.perf_counter()
+        deadline = time.monotonic() + timeout
+        with self._ack_cond:
+            while True:
+                links = self._repl_links
+                floor = min(self.quorum, len(links))
+                acked = sum(
+                    1 for link in links
+                    if link.acked_seq is not None and link.acked_seq >= seq
+                )
+                if acked >= floor:
+                    TELEMETRY.observe(
+                        "storage.quorum.wait", time.perf_counter() - t0
+                    )
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    TELEMETRY.observe(
+                        "storage.quorum.wait", time.perf_counter() - t0
+                    )
+                    TELEMETRY.count("storage.quorum.timeouts")
+                    return False
+                self._ack_cond.wait(remaining)
+
+    def quorum_timeout_reply(self, op, seq):
+        """The reply for a sync write whose replica acks never arrived:
+        the op DID apply locally, so the wire carries ``maybe_applied`` —
+        transient for the retry classifier (MODE_ALWAYS ops converge via
+        their duplicate-key discipline; MODE_UNAPPLIED ops give up instead
+        of double-applying)."""
+        with self._repl_lock:
+            epoch = self.epoch
+        return {
+            "ok": False,
+            "error": "DatabaseError",
+            "message": (
+                f"quorum not reached for {op!r} at seq {seq}: fewer than "
+                f"{self.quorum} replica(s) acknowledged within "
+                f"{self.quorum_timeout:.1f}s — the write applied locally "
+                "but its replication guarantee is not met"
+            ),
+            "maybe_applied": True,
+            "quorum_timeout": True,
+        }
+
     def demote(self, peer_epoch):
         """Runtime primary -> replica demotion: a peer proved a NEWER epoch
         exists, so every local write since that election is a condemned
@@ -1058,6 +1216,10 @@ class DBServer(socketserver.ThreadingTCPServer):
                 "replica": self._is_replica,
                 "epoch": self.epoch,
                 "resyncing": self._resync_pending,
+                # The ack floor rides the probe so `db status` can render
+                # each shard's write contract; pre-upgrade clients ignore
+                # unknown keys — wire-compatible both ways.
+                "quorum": self.quorum,
             }
 
     def read_stamp(self):
@@ -1303,11 +1465,11 @@ class DBServer(socketserver.ThreadingTCPServer):
 
 
 def serve(host="127.0.0.1", port=8765, persist=None, secret=None,
-          replicate_to=None, replica=False):  # pragma: no cover - CLI
+          replicate_to=None, replica=False, quorum=0):  # pragma: no cover - CLI
     """Blocking server entry point (`orion-tpu db serve`)."""
     server = DBServer(
         host=host, port=port, persist=persist, secret=secret,
-        replicate_to=replicate_to, replica=replica,
+        replicate_to=replicate_to, replica=replica, quorum=quorum,
     )
     log.info("serving orion-tpu DB on %s:%s", *server.address)
     auth = "shared-secret auth" if secret else "NO auth (open server)"
@@ -1316,6 +1478,8 @@ def serve(host="127.0.0.1", port=8765, persist=None, secret=None,
         role = f", replicating to {len(list(replicate_to))} replica(s)"
     elif replica:
         role = ", read replica"
+    if quorum:
+        role += f", quorum={int(quorum)}"
     print(
         f"orion-tpu db server listening on "
         f"{server.address[0]}:{server.address[1]} ({auth}{role})"

@@ -44,6 +44,7 @@ exactly why the fence grace must cover ``placement_ttl``.
 
 import logging
 import time
+from collections import Counter
 
 from orion_tpu.health import FLIGHT
 from orion_tpu.storage.audit import audit_experiment
@@ -67,6 +68,14 @@ EXPERIMENT_COLLECTIONS = (
     "spans",
     "health",
 )
+
+#: Diagnostics channels whose ``_id`` is the backend's per-shard
+#: auto-increment counter: the same integer id names DIFFERENT documents
+#: on different shards, so two experiments migrating onto one shard
+#: collide on ``_id`` even though their documents are unrelated.  These
+#: move by experiment-scoped CONTENT (id stripped, destination assigns a
+#: fresh id); everything else keeps its id byte-identical.
+AUTO_ID_COLLECTIONS = frozenset(("telemetry", "metrics", "spans", "health"))
 
 #: Batched-write chunk for the copy path: one ``apply_batch`` wire request
 #: per chunk (one lock hold / transaction server-side).
@@ -132,11 +141,12 @@ class Rebalancer:
     :class:`~orion_tpu.storage.shard.ShardedNetworkDB` router.
 
     ``crash_at`` is a test hook called with a stage label per experiment
-    (``"after_copy"``, ``"after_fence"``, ``"after_flip"``); raising from
-    it simulates a migrator crash at that exact point — the crash-resume
-    suite drives it.  ``fence_grace`` defaults to the router's placement
-    TTL: the flip is only safe once every router's cached pre-fence
-    placement has expired."""
+    (``"after_pin"``, ``"after_copy"``, ``"after_fence"``,
+    ``"after_verify"``, ``"after_flip"``); raising from it simulates a
+    migrator crash at that exact point — the crash-resume suite drives
+    it.  ``fence_grace`` defaults to the router's placement TTL: the flip
+    is only safe once every router's cached pre-fence placement has
+    expired."""
 
     def __init__(self, router, retry=None, fence_grace=None, copy_batch=COPY_BATCH,
                  crash_at=None, sleep=time.sleep):
@@ -249,25 +259,32 @@ class Rebalancer:
         movers = [m for m in plan.moves if m.state != "moved"]
         finishers = [m for m in plan.moves if m.state == "moved"]
         # Phase 1+2: pin + copy (routers keep writing to the source).
+        self._note_phase("pin_copy")
         for move in movers:
             if move.state is None:
                 self._set_placement(move, "pinned", self._identity(move.src_index))
                 move.state = "pinned"
+                self._hook("after_pin", move)
             self._copy(move)
             self._hook("after_copy", move)
+            self._note_progress()
         # Phase 3: fence every mover, then ONE grace wait covering the
         # placement TTL — after it, every router observes the fence.
+        self._note_phase("fence")
         for move in movers:
             if move.state == "pinned":
                 self._set_placement(move, "fenced", self._identity(move.src_index))
                 move.state = "fenced"
                 self._hook("after_fence", move)
+                self._note_progress()
         if movers and self.fence_grace > 0:
             self._sleep(self.fence_grace)
         # Phase 4: delta-copy + verify + flip, one mover at a time.
+        self._note_phase("verify_flip")
         for move in movers:
             self._copy(move)  # the delta written since the first pass
             self._verify(move)
+            self._hook("after_verify", move)
             self._set_placement(move, "moved", self._identity(move.dst_index))
             move.state = "moved"
             if FLIGHT.enabled:
@@ -276,18 +293,30 @@ class Rebalancer:
                     args={"experiment": move.exp_id, "dst": move.dst_index},
                 )
             self._hook("after_flip", move)
+            self._note_progress()
         # Phase 5+6: delete the source copy, then drop the override — the
         # ring IS the placement again.
+        self._note_phase("cleanup")
         for move in movers + finishers:
             self._delete_source(move)
             self._drop_placement(move)
             TELEMETRY.count("storage.shard.rebalanced_experiments")
             log.info("rebalanced %s", move.describe())
+            self._note_progress()
+        self._note_phase(None)
         return plan
 
     def _hook(self, stage, move):
         if self.crash_at is not None:
             self.crash_at(stage, move.exp_id)
+
+    def _note_phase(self, name):
+        """Phase-boundary hook (``None`` = run complete).  The base
+        migrator publishes nothing; the drain specialization books the
+        ``storage.drain.phase_age_s`` gauge the DX060 doctor rule watches."""
+
+    def _note_progress(self):
+        """Per-move progress hook inside a phase (see :meth:`_note_phase`)."""
 
     def _identity(self, index):
         conn = self._conns[index]
@@ -297,11 +326,19 @@ class Rebalancer:
         return f"{conn.host}:{conn.port}"  # pragma: no cover - defensive
 
     # --- placement ops (STO005: batched + explicit retry mode) ---------------
+    def _placement_conn(self, move):
+        """The shard holding ``move``'s override doc: the experiment's
+        CURRENT-ring home — the destination for a rebalance (the ring
+        already points there), the SOURCE for a drain (the drained shard
+        is still on the routers' ring until ``set_topology`` drops it)."""
+        return self._conns[move.dst_index]
+
     def _set_placement(self, move, state, identity):
-        """Upsert the override doc on the experiment's ring (destination)
-        shard — the single-doc CAS every router's routing consults.
-        Converges under re-application: an absolute by-id upsert."""
-        dst = self._conns[move.dst_index]
+        """Upsert the override doc on the experiment's ring shard
+        (:meth:`_placement_conn`) — the single-doc CAS every router's
+        routing consults.  Converges under re-application: an absolute
+        by-id upsert."""
+        dst = self._placement_conn(move)
         doc_id = placement_doc_id(move.exp_id)
         fields = {
             "experiment": move.exp_id,
@@ -324,7 +361,7 @@ class Rebalancer:
         )
 
     def _drop_placement(self, move):
-        dst = self._conns[move.dst_index]
+        dst = self._placement_conn(move)
         doc_id = placement_doc_id(move.exp_id)
         self.policy.run(
             lambda: dst.remove(PLACEMENT_COLLECTION, {"_id": doc_id}),
@@ -355,21 +392,36 @@ class Rebalancer:
             if not src_docs:
                 continue
             dst_docs = self._exp_docs(dst, collection, move.exp_id)
-            dst_by_id = {d.get("_id"): _canonical(d) for d in dst_docs}
             ops = []
-            for doc in src_docs:
-                _id = doc.get("_id")
-                have = dst_by_id.get(_id)
-                if have is None:
-                    ops.append(("write", [collection, doc], {}))
-                elif have != _canonical(doc):
-                    ops.append(
-                        (
-                            "write",
-                            [collection, _strip_id(doc)],
-                            {"query": {"_id": _id}},
+            if collection in AUTO_ID_COLLECTIONS:
+                # Content-keyed diff: insert only the multiset difference,
+                # id stripped so the destination assigns from ITS counter
+                # (a copied id could collide with a co-resident
+                # experiment's rows).  Convergent under crash/re-run —
+                # already-copied rows count toward the destination
+                # multiset regardless of the id they landed under.
+                have = Counter(_canonical(_strip_id(d)) for d in dst_docs)
+                for doc in src_docs:
+                    key = _canonical(_strip_id(doc))
+                    if have[key] > 0:
+                        have[key] -= 1
+                        continue
+                    ops.append(("write", [collection, _strip_id(doc)], {}))
+            else:
+                dst_by_id = {d.get("_id"): _canonical(d) for d in dst_docs}
+                for doc in src_docs:
+                    _id = doc.get("_id")
+                    have = dst_by_id.get(_id)
+                    if have is None:
+                        ops.append(("write", [collection, doc], {}))
+                    elif have != _canonical(doc):
+                        ops.append(
+                            (
+                                "write",
+                                [collection, _strip_id(doc)],
+                                {"query": {"_id": _id}},
+                            )
                         )
-                    )
             for start in range(0, len(ops), self.copy_batch):
                 chunk = ops[start:start + self.copy_batch]
                 outcomes = self.policy.run(
@@ -401,6 +453,21 @@ class Rebalancer:
             if not src_docs:
                 continue
             dst_docs = self._exp_docs(dst, collection, move.exp_id)
+            if collection in AUTO_ID_COLLECTIONS:
+                # Auto-increment channels moved by content: every source
+                # row must exist on the destination with identical bytes
+                # OUTSIDE the id (the destination assigned its own).
+                have = Counter(_canonical(_strip_id(d)) for d in dst_docs)
+                for doc in src_docs:
+                    key = _canonical(_strip_id(doc))
+                    if have[key] <= 0:
+                        raise DatabaseError(
+                            f"rebalance verify failed for {move.exp_id}: "
+                            f"{collection} doc {doc.get('_id')!r} missing "
+                            "on the destination shard"
+                        )
+                    have[key] -= 1
+                continue
             dst_by_id = {d.get("_id"): _canonical(d) for d in dst_docs}
             for doc in src_docs:
                 have = dst_by_id.get(doc.get("_id"))

@@ -105,6 +105,13 @@ PROBE_TIMEOUT_S = 2.0
 #: box heals inside one op-level retry deadline.
 DEFAULT_PROMOTE_AFTER_S = 1.5
 
+#: Confirmation window before automatic replica REPROVISIONING: a shard's
+#: replica must fail probes continuously for this long before the router
+#: provisions and adopts a replacement.  Longer than the promotion window
+#: on purpose — a replica rebooting in place is cheaper than a fresh
+#: snapshot resync, so reprovisioning waits out ordinary restarts.
+DEFAULT_REPROVISION_AFTER_S = 5.0
+
 #: Collection holding per-experiment placement override docs (live ring
 #: rebalancing, storage/rebalance.py).  Routers consult it BEFORE the
 #: ring; the docs live on the experiment's RING shard so any router can
@@ -457,6 +464,25 @@ class _Shard:
             TSAN.write("ShardedNetworkDB._shard_state", self)
             self.replica_stale_reads += 1
 
+    def adopt_replacement(self, replica_index, client, dead_addr, now):
+        """Swap a dead replica's client for a freshly provisioned one
+        (auto-reprovisioning).  The replacement is benched briefly — it
+        starts empty and must snapshot-resync before serving reads — and
+        the declared ``replica_addrs`` identity follows the swap, so a
+        later ``set_topology`` matching on it compares against the set
+        this shard ACTUALLY runs.  Returns the replaced client (closed by
+        the caller, outside this lock)."""
+        addr = f"{client.host}:{client.port}"
+        with self._lock:
+            TSAN.write("ShardedNetworkDB._shard_state", self)
+            old = self.replicas[replica_index]
+            self.replicas[replica_index] = client
+            self._down_until[replica_index] = now + REPLICA_RETRY_S
+            self.replica_addrs = frozenset(
+                (self.replica_addrs - {dead_addr}) | {addr}
+            )
+        return old
+
     def close(self):
         self.primary.close()
         for replica in self.replicas:
@@ -494,6 +520,8 @@ class ShardedNetworkDB:
         auto_promote=True,
         promote_after=DEFAULT_PROMOTE_AFTER_S,
         placement_ttl=PLACEMENT_TTL_S,
+        replica_provisioner=None,
+        reprovision_after=DEFAULT_REPROVISION_AFTER_S,
     ):
         specs = parse_shard_specs(shards, default_secret=secret)
         self._client_base = {
@@ -544,8 +572,34 @@ class ShardedNetworkDB:
         self._stats_lock = threading.Lock()
         self.fan_outs = 0
         self._monotonic = None  # injectable clock for tests
+        #: Replica auto-reprovisioning (day-2 operations): with a
+        #: ``replica_provisioner`` callable — ``provisioner(shard_index) ->
+        #: "host:port"`` of a freshly started empty server — a background
+        #: sweep detects a replica that has failed probes continuously for
+        #: ``reprovision_after`` seconds on a PROMOTED shard (the
+        #: one-replica-short-forever state a permanent primary loss leaves
+        #: behind), provisions a replacement, has the current primary adopt
+        #: it over the ``adopt_replica`` wire op (bounded snapshot resync),
+        #: and swaps the dead client out of the shard's replica set.
+        self.replica_provisioner = replica_provisioner
+        self.reprovision_after = float(reprovision_after)
+        self.reprovisions = 0
+        self._reprovision_lock = threading.Lock()
+        #: (shard identity, replica address) -> monotonic first-failure
+        #: time; shared between the sweep thread and close() — every
+        #: access under _reprovision_lock, TSAN-annotated.
+        self._replica_down_since = {}
+        self._reprovision_stop = threading.Event()
+        self._reprovision_thread = None
         self._register_shard_counters()
         _ROUTER_REGISTRY.add(self)
+        if replica_provisioner is not None:
+            self._reprovision_thread = threading.Thread(
+                target=self._reprovision_loop,
+                name="shard-reprovision",
+                daemon=True,
+            )
+            self._reprovision_thread.start()
 
     _SHARD_COUNTER_ATTRS = (
         "reconnects", "failovers", "replica_stale_reads", "promotions",
@@ -657,6 +711,7 @@ class ShardedNetworkDB:
                 entry["seq"] = primary_seq
                 entry["epoch"] = int(info.get("epoch", 0) or 0)
                 entry["role"] = "replica" if info.get("replica") else "primary"
+                entry["quorum"] = int(info.get("quorum", 0) or 0)
                 shard.note_epoch(entry["epoch"])
             lags = []
             for replica in shard.replicas:
@@ -763,6 +818,9 @@ class ShardedNetworkDB:
 
     def close(self):
         _ROUTER_REGISTRY.discard(self)
+        self._reprovision_stop.set()
+        if self._reprovision_thread is not None:
+            self._reprovision_thread.join(timeout=2.0)
         for shard in self._shards:
             shard.close()
 
@@ -1274,6 +1332,121 @@ class ShardedNetworkDB:
             "promoted" if elected else "adopted",
             winner.host, winner.port, epoch, shard.identity,
         )
+
+    # --- replica auto-reprovisioning (day-2 operations) ----------------------
+    def _reprovision_loop(self):
+        """Background sweep: probe replica health and replace the dead.
+        Runs only when a ``replica_provisioner`` is configured; never
+        raises — replica repair must not take the router down with it."""
+        interval = max(0.25, min(1.0, self.reprovision_after / 4.0))
+        while not self._reprovision_stop.wait(interval):
+            try:
+                self._reprovision_sweep()
+            except Exception:  # pragma: no cover - defensive
+                log.debug("reprovision sweep failed", exc_info=True)
+
+    def _reprovision_sweep(self):
+        shards = list(self._shards)
+        now = self._now()
+        live = {s.identity for s in shards}
+        with self._reprovision_lock:
+            TSAN.write("ShardedNetworkDB._replica_down", self)
+            # Entries for shards a topology change removed never fire.
+            for key in [
+                k for k in self._replica_down_since if k[0] not in live
+            ]:
+                del self._replica_down_since[key]
+        for shard in shards:
+            if self._reprovision_stop.is_set():
+                return
+            if shard.epoch_floor() == 0:
+                # Never promoted: the configured replica set is authoritative
+                # and a down replica is expected to come back AS ITSELF (a
+                # reboot) — reprovisioning belongs to the post-promotion
+                # one-short-forever state.
+                continue
+            if shard.failing_for(now) > 0:
+                # The PRIMARY is failing: adoption has nobody to talk to,
+                # and the election machinery owns this phase.
+                continue
+            for replica_index, replica in enumerate(list(shard.replicas)):
+                addr = f"{replica.host}:{replica.port}"
+                key = (shard.identity, addr)
+                try:
+                    self._probe_seq(replica)
+                except Exception:
+                    with self._reprovision_lock:
+                        TSAN.write("ShardedNetworkDB._replica_down", self)
+                        since = self._replica_down_since.setdefault(key, now)
+                    if now - since >= self.reprovision_after:
+                        self._reprovision(shard, replica_index, addr)
+                else:
+                    with self._reprovision_lock:
+                        TSAN.write("ShardedNetworkDB._replica_down", self)
+                        self._replica_down_since.pop(key, None)
+
+    def _reprovision(self, shard, replica_index, dead_addr):
+        """Provision and adopt a replacement for one dead replica: ask the
+        provisioner for a fresh empty server, tell the shard's CURRENT
+        primary to adopt it (``adopt_replica`` — the pusher's ordinary gap
+        logic snapshot-resyncs it, bounded by the server's resync gate),
+        then swap the dead client out of the router's replica set."""
+        TELEMETRY.set_gauge("storage.reprovision.in_progress", 1)
+        if FLIGHT.enabled:
+            FLIGHT.record(
+                "reprovision.start",
+                args={"shard": shard.index, "dead": dead_addr},
+            )
+        try:
+            address = self.replica_provisioner(shard.index)
+            host, _, port = str(address).rpartition(":")
+            if not host or not port:
+                raise DatabaseError(  # lint: disable=STO004 -- caught by this method's own except; retried next sweep, never a client reply
+                    f"provisioner returned {address!r}; expected host:port"
+                )
+            result = shard.primary._call(
+                "adopt_replica", {"address": f"{host}:{int(port)}"}
+            ) or {}
+            if not result.get("adopted"):
+                raise DatabaseError(  # lint: disable=STO004 -- caught by this method's own except; retried next sweep, never a client reply
+                    f"shard {shard.index} primary refused to adopt "
+                    f"{address!r}: {result}"
+                )
+            client = NetworkDB(
+                host=host, port=int(port),
+                **dict(self._client_base, secret=shard.primary.secret),
+            )
+            old = shard.adopt_replacement(
+                replica_index, client, dead_addr, self._now()
+            )
+            old.close()
+            with self._reprovision_lock:
+                TSAN.write("ShardedNetworkDB._replica_down", self)
+                self._replica_down_since.pop((shard.identity, dead_addr), None)
+                self.reprovisions += 1
+            TELEMETRY.count("storage.shard.reprovisions")
+            if FLIGHT.enabled:
+                FLIGHT.record(
+                    "reprovision.done",
+                    args={
+                        "shard": shard.index,
+                        "dead": dead_addr,
+                        "replica": f"{host}:{port}",
+                    },
+                )
+            log.warning(
+                "shard %d: reprovisioned dead replica %s -> %s",
+                shard.index, dead_addr, f"{host}:{port}",
+            )
+        except Exception as exc:
+            # The window keeps running: the NEXT sweep past the threshold
+            # retries (a provisioner outage must not wedge repair forever).
+            log.warning(
+                "shard %d: reprovisioning replica %s failed: %s",
+                shard.index, dead_addr, exc,
+            )
+        finally:
+            TELEMETRY.set_gauge("storage.reprovision.in_progress", 0)
 
     # --- AbstractDB contract -------------------------------------------------
     def ping(self):

@@ -62,16 +62,19 @@ class _ShardDeployment:
     so a restart is lossless), and the fault proxy clients dial through."""
 
     def __init__(self, index, replicas, persist_dir, secret=None,
-                 client_timeout=5.0):
+                 client_timeout=5.0, quorum=0):
         self.index = index
         self.secret = secret
         self.client_timeout = client_timeout
+        self.quorum = quorum
         self.persist = (
             os.path.join(persist_dir, f"shard{index}.pkl") if persist_dir else None
         )
         self.replica_servers = []
         for _ in range(replicas):
-            server = DBServer(port=0, secret=secret, replica=True)
+            # Replicas carry the quorum floor too: the one a promotion
+            # elects becomes a primary and must keep enforcing it.
+            server = DBServer(port=0, secret=secret, replica=True, quorum=quorum)
             server.serve_background()
             self.replica_servers.append(server)
         self.primary_host = "127.0.0.1"
@@ -93,6 +96,7 @@ class _ShardDeployment:
             persist_interval=0.05,
             secret=self.secret,
             replicate_to=[s.address for s in self.replica_servers if s is not None],
+            quorum=self.quorum,
         )
 
     def serve_spec(self):
@@ -204,12 +208,15 @@ class _ShardDeployment:
 class SoakTopology:
     """An in-process sharded, replicated deployment under fault control."""
 
-    def __init__(self, n_shards=3, replicas=2, persist_dir=None, secret=None):
+    def __init__(self, n_shards=3, replicas=2, persist_dir=None, secret=None,
+                 quorum=0):
         self.replicas = replicas
         self.persist_dir = persist_dir
         self.secret = secret
+        self.quorum = quorum
         self.shards = [
-            _ShardDeployment(i, replicas, persist_dir, secret=secret)
+            _ShardDeployment(i, replicas, persist_dir, secret=secret,
+                             quorum=quorum)
             for i in range(n_shards)
         ]
 
@@ -225,6 +232,7 @@ class SoakTopology:
             self.replicas if replicas is None else replicas,
             self.persist_dir,
             secret=self.secret,
+            quorum=self.quorum,
         )
         self.shards.append(shard)
         return shard
@@ -339,6 +347,81 @@ def grow_and_rebalance(topology, storages, fence_grace=0.3,
         if admin is not None:
             admin.close()
     return outcome
+
+
+def drain_and_remove(topology, storages, fence_grace=0.3,
+                     placement_ttl=0.2, drain_index=None):
+    """The drain-mid-soak hook body, shared by ``bench.py --soak`` and the
+    tier-1 pin (the gate and the pin must exercise ONE scenario): drain
+    one shard — the one holding the most experiments unless
+    ``drain_index`` says otherwise, so removal always runs under live
+    data — through the crash-resumable migrator (storage/drain.py),
+    verify zero experiments remain on it, retarget every live router to
+    the surviving topology, then stop the drained deployment.  Returns
+    ``{"planned": <plan summary>, "ring_share": f, "residual": 0,
+    "drained_index": i, "n_shards": N, "executed": True}``."""
+    from orion_tpu.storage.drain import Drainer
+
+    outcome = {}
+    admin = topology.make_router(
+        replica_reads=False, placement_ttl=placement_ttl
+    )
+    try:
+        if drain_index is None:
+            loads = {
+                index: len(conn.read("experiments", {}))
+                for index, conn in admin.shard_connections()
+            }
+            drain_index = max(loads, key=lambda index: loads[index])
+        drainer = Drainer(admin, drain_index, fence_grace=fence_grace)
+        plan = drainer.plan()
+        outcome["planned"] = plan.summary()
+        outcome["ring_share"] = drainer.ring_share()
+        outcome["drained_index"] = drain_index
+        drainer.run(plan)
+        outcome["residual"] = len(drainer.residual_experiments())
+        # Only now does the shard leave the topology: survivors' ring ==
+        # the drainer's destination ring (same identities, same vnodes),
+        # so placement doesn't shift again.
+        drained = topology.shards.pop(drain_index)
+        specs = topology.specs()
+        for storage in storages:
+            storage.db.set_topology(specs)
+        drained.stop()
+        outcome["n_shards"] = len(topology.shards)
+        outcome["executed"] = True
+    finally:
+        admin.close()
+    return outcome
+
+
+class ReplicaProvisioner:
+    """A fresh empty replica server per request — the soak/test stand-in
+    for a real fleet's machine allocator, handed to the router as its
+    ``replica_provisioner``.  Tracks what it started so the caller can
+    stop them."""
+
+    def __init__(self, secret=None, quorum=0):
+        self.secret = secret
+        self.quorum = quorum
+        self.servers = []
+        self._lock = threading.Lock()
+
+    def __call__(self, shard_index):
+        server = DBServer(
+            port=0, secret=self.secret, replica=True, quorum=self.quorum
+        )
+        server.serve_background()
+        with self._lock:
+            self.servers.append(server)
+        return "%s:%s" % server.address
+
+    def stop(self):
+        with self._lock:
+            servers, self.servers = list(self.servers), []
+        for server in servers:
+            server.shutdown()
+            server.server_close()
 
 
 class SoakResult:

@@ -161,3 +161,244 @@ def test_chaos_long_schedule_soak(tmp_path, backend, telemetry_enabled):
     finally:
         if cleanup is not None:
             cleanup()
+
+
+# --- day-2 chaos: replica kill mid-drain + quorum-ack drop (ISSUE 20) --------
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+def _day2_db(backend, tmp_path, tag, schedule):
+    """(db_or_None, cleanup_or_None) — the backend-under-test store a
+    shard primary runs, wrapped in the seeded FaultyDB.  ``network``
+    keeps the server's native store (the wire layer IS that backend's
+    subject; its extra leg is the client-side fault proxy)."""
+    if backend == "memory":
+        return FaultyDB(MemoryDB(), schedule), None
+    if backend == "pickled":
+        from orion_tpu.storage.backends import PickledDB
+
+        return FaultyDB(PickledDB(str(tmp_path / f"{tag}.pkl")), schedule), None
+    if backend == "sqlite":
+        from orion_tpu.storage.sqlitedb import SQLiteDB
+
+        inner = SQLiteDB(str(tmp_path / f"{tag}.sqlite"))
+        return FaultyDB(inner, schedule), inner.close
+    return None, None
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_chaos_drain_survives_replica_kill_and_quorum_ack_drop(
+    tmp_path, backend, telemetry_enabled
+):
+    """The day-2 leg: a seeded FaultyDB shard drain loses a replica AND
+    every quorum ack mid-flight.  The contract under fire: sync writes
+    during the ack blackout either apply everywhere (once acks return)
+    or raise ``maybe_applied`` — never silently vanish; the drain RESUMES
+    after the crash; the survivor audits clean on every backend."""
+    import time as _time
+
+    from orion_tpu.core.experiment import experiment_id
+    from orion_tpu.storage.audit import audit_storage
+    from orion_tpu.storage.documents import dumps_canonical
+    from orion_tpu.storage.drain import Drainer
+    from orion_tpu.storage.netdb import DBServer, NetworkDB
+    from orion_tpu.storage.retry import MODE_ALWAYS, RetryPolicy
+    from orion_tpu.storage.shard import ShardedNetworkDB
+    from orion_tpu.utils.exceptions import DatabaseError, DuplicateKeyError
+
+    schedule = FaultSchedule(
+        seed=29, plan={4: "error", 9: "latency", 15: "reply_lost"},
+        rates={"error": 0.02, "latency": 0.02}, latency=0.005, max_faults=8,
+    )
+    policy = RetryPolicy(
+        max_attempts=10, base_delay=0.005, max_delay=0.05, deadline=30.0
+    )
+
+    def _r(fn):
+        """Populate/verify ops ride the same always-retry discipline the
+        soak workers do; a DuplicateKeyError on a resend means an earlier
+        reply-lost attempt already applied — converged."""
+        try:
+            return policy.run(fn, op="day2", mode=MODE_ALWAYS)
+        except DuplicateKeyError:
+            return None
+
+    cleanups = []
+    crashed = {"done": False}
+    # Victim shard: quorum=1 over two replicas, each reached THROUGH a
+    # fault proxy so the test can freeze the ack stream without killing
+    # the processes.
+    replicas, repl_proxies = [], []
+    for _ in range(2):
+        replica = DBServer(port=0, replica=True)
+        replica.serve_background()
+        proxy = FaultProxy(*replica.address)
+        proxy.serve_background()
+        replicas.append(replica)
+        repl_proxies.append(proxy)
+    victim = DBServer(
+        port=0, replicate_to=[p.address for p in repl_proxies],
+        quorum=1, quorum_timeout=0.3,
+    )
+    db, closer = _day2_db(backend, tmp_path, "victim", schedule)
+    victim.db = db if db is not None else FaultyDB(victim.db, schedule)
+    if closer is not None:
+        cleanups.append(closer)
+    victim.serve_background()
+    # The survivor runs the SAME backend: the post-drain audit must come
+    # back clean on the backend under test, not a stand-in.
+    survivor = DBServer(port=0)
+    db, closer = _day2_db(backend, tmp_path, "survivor", schedule)
+    if db is not None:
+        survivor.db = db
+    if closer is not None:
+        cleanups.append(closer)
+    survivor.serve_background()
+    victim_spec = {"host": victim.address[0], "port": victim.address[1]}
+    client_proxy = None
+    if backend == "network":
+        # The network backend's extra leg: the router dials the victim
+        # through a fault proxy whose drops force real reconnects.
+        client_proxy = FaultProxy(*victim.address)
+        client_proxy.serve_background()
+        victim_spec = {
+            "host": client_proxy.address[0], "port": client_proxy.address[1],
+        }
+    router = ShardedNetworkDB(
+        [victim_spec,
+         {"host": survivor.address[0], "port": survivor.address[1]}],
+        reconnect_jitter=0, timeout=5.0, placement_ttl=0.2,
+    )
+    direct = NetworkDB(
+        host=victim.address[0], port=victim.address[1], timeout=5.0,
+        reconnect_jitter=0,
+    )
+    try:
+        names = [f"day2-{e}" for e in range(8)]
+        eids = []
+        for name in names:
+            eid = experiment_id(name, 1, "u")
+            eids.append(eid)
+            _r(lambda doc={"_id": eid, "name": name, "version": 1,
+                           "metadata": {"user": "u"}}:
+               router.write("experiments", doc))
+            for i in range(2):
+                _r(lambda doc={
+                    "_id": f"{eid}-t{i}", "experiment": eid,
+                    "status": "completed", "objective": float(i),
+                    "params": {"/x": float(i)},
+                    "results": [{"name": "obj", "type": "objective",
+                                 "value": float(i)}],
+                    "submit_time": 1.0, "start_time": 1.0, "end_time": 2.0,
+                    "heartbeat": 2.0,
+                }: router.write("trials", doc))
+        if not any(router.shard_for(eid) == 0 for eid in eids):
+            pytest.skip("ring placed nothing on the victim (rare draw)")
+
+        def snapshot():
+            by_id = {}
+            for eid in eids:
+                docs = _r(
+                    lambda eid=eid: router.read("trials", {"experiment": eid})
+                )
+                for doc in docs:
+                    by_id[doc["_id"]] = dumps_canonical(doc)
+            return by_id
+
+        before = snapshot()
+
+        def crash_once(stage, exp_id):
+            if stage == "after_copy" and not crashed["done"]:
+                crashed["done"] = True
+                # Mid-drain: one replica dies outright, the other's ack
+                # stream blackholes — every quorum ack is now dropped.
+                replicas[0].shutdown()
+                replicas[0].server_close()
+                repl_proxies[0].stop()
+                repl_proxies[1].set_blackhole(True)
+                if client_proxy is not None:
+                    client_proxy.drop_all()
+                raise _Crash(f"mid-drain kill at {exp_id}")
+
+        wounded = Drainer(router, 0, fence_grace=0.1, crash_at=crash_once)
+        plan = wounded.plan()
+        assert plan.moves and not plan.strays
+        with pytest.raises(_Crash):
+            wounded.run(plan)
+        assert crashed["done"]
+        # The ack blackout: a sync write applies locally but the reply is
+        # maybe_applied — the zero-silent-loss half of the contract.
+        saw_maybe_applied = False
+        for _ in range(20):
+            try:
+                direct.write(
+                    "lying_trials",
+                    {"_id": "quorum-probe", "experiment": "x"},
+                )
+            except DuplicateKeyError:
+                break  # an earlier maybe_applied attempt already applied
+            except DatabaseError as exc:
+                if getattr(exc, "maybe_applied", False):
+                    saw_maybe_applied = True
+                    break
+                _time.sleep(0.02)  # an injected fault; probe again
+            else:  # pragma: no cover - acks are blackholed
+                break
+        assert saw_maybe_applied, "ack blackout never surfaced maybe_applied"
+        assert _r(
+            lambda: direct.read("lying_trials", {"_id": "quorum-probe"})
+        ), "maybe_applied write is not on the primary"
+        # Acks return; the drain RESUMES from the standing placement docs.
+        repl_proxies[1].set_blackhole(False)
+        repl_proxies[1].drop_all()
+        resumed = Drainer(router, 0, fence_grace=0.1)
+        resumed.run()
+        assert resumed.residual_experiments() == []
+        # ... and the blackout write reached the surviving replica: the
+        # apply-everywhere half of the contract.
+        reader = NetworkDB(
+            host=replicas[1].address[0], port=replicas[1].address[1],
+            reconnect_jitter=0,
+        )
+        try:
+            deadline = _time.monotonic() + 10.0
+            while _time.monotonic() < deadline and not reader.read(
+                "lying_trials", {"_id": "quorum-probe"}
+            ):
+                _time.sleep(0.05)
+            assert reader.read("lying_trials", {"_id": "quorum-probe"})
+        finally:
+            reader.close()
+        # Drop the shard; everything must live on the survivor, clean.
+        router.set_topology(
+            [{"host": survivor.address[0], "port": survivor.address[1]}]
+        )
+        assert snapshot() == before, "documents changed across the drain"
+        reports = audit_storage(
+            DocumentStorage(router, retry=RETRY), lost_timeout=3600.0
+        )
+        assert all(r.ok for r in reports), [r.violations for r in reports]
+        assert schedule.total_injected > 0, "fault schedule never fired"
+    finally:
+        direct.close()
+        router.close()
+        for cleanup in cleanups:
+            cleanup()
+        if client_proxy is not None:
+            client_proxy.stop()
+        for proxy in repl_proxies[1:]:
+            proxy.stop()
+        if not crashed["done"]:
+            repl_proxies[0].stop()
+        for server in [victim, survivor, replicas[1]] + (
+            [] if crashed["done"] else [replicas[0]]
+        ):
+            try:
+                server.shutdown()
+                server.server_close()
+            except Exception:
+                pass

@@ -18,8 +18,10 @@ import pytest
 
 from orion_tpu.storage.faults import FaultSchedule, FaultyDB
 from orion_tpu.storage.soak import (
+    ReplicaProvisioner,
     SoakTopology,
     busiest_shard,
+    drain_and_remove,
     drive_soak,
     grow_and_rebalance,
 )
@@ -171,6 +173,143 @@ def test_rebalance_soak_tiny(tmp_path, telemetry_enabled):
     # a shard index >= 3 OR nothing hashed there (moves landed elsewhere) —
     # the audits above already covered every shard either way.
     assert set(result.completed_per_shard) == {s.index for s in topo.shards}
+
+
+@pytest.mark.chaos
+def test_drain_soak_tiny(tmp_path, telemetry_enabled):
+    """Tier-1 drain-mid-soak (ISSUE 20): the busiest shard is DRAINED and
+    REMOVED at the worker barrier — survivor-ring migration, zero
+    residual, every live router retargeted — and the workers finish on
+    the shrunk topology with zero lost observations and clean audits.
+    The twin of the ``bench.py --soak`` drain gate (one shared scenario:
+    ``drain_and_remove``)."""
+    topo = SoakTopology(n_shards=3, replicas=1, persist_dir=str(tmp_path))
+    outcome = {}
+
+    def drain_hook(storages):
+        outcome.update(drain_and_remove(topo, storages))
+
+    try:
+        result = drive_soak(
+            topo, n_workers=12, n_experiments=8, trials_per_worker=4,
+            n_routers=4, chaos=False, mid_hook=drain_hook, deadline=120.0,
+        )
+    finally:
+        topo.stop()
+    _assert_soak_outcome(result)
+    assert outcome.get("executed") is True
+    assert outcome["residual"] == 0
+    assert outcome["planned"]["moves"] >= 1
+    assert outcome["n_shards"] == 2
+    # The drained fraction tracks the shard's true ring share (2x bound:
+    # hash variance on 8 experiments is wide, systematic drift is not).
+    assert outcome["planned"]["move_fraction"] <= 2.0 * outcome["ring_share"]
+    # Everything now lives on (and audits clean on) the two survivors.
+    assert set(result.completed_per_shard) == {0, 1}
+
+
+@pytest.mark.chaos
+def test_quorum_soak_kill_without_catchup_tiny(tmp_path, telemetry_enabled):
+    """Tier-1 quorum soak (ISSUE 20): ``quorum=1`` over 2 replicas, the
+    busiest primary killed with NO replication catch-up wait — the ack
+    floor itself is the zero-loss mechanism (an acked sync write is on a
+    replica by construction; the max-seq election winner carries it)."""
+    topo = SoakTopology(
+        n_shards=3, replicas=2, persist_dir=str(tmp_path), quorum=1,
+    )
+
+    def chaos_once(storages):
+        victim = busiest_shard(topo, storages[0].db, 6)
+        topo.shards[victim].kill_primary(wait_catchup=False)
+
+    try:
+        result = drive_soak(
+            topo, n_workers=12, n_experiments=6, trials_per_worker=4,
+            n_routers=4, chaos=False, mid_hook=chaos_once, deadline=120.0,
+        )
+    finally:
+        topo.stop()
+    _assert_soak_outcome(result)
+    assert result.primary_kills == 1
+    assert result.promotions >= 1, (
+        "primary killed but nothing promoted: " + str(result.summary())
+    )
+
+
+@pytest.mark.chaos
+def test_replica_auto_reprovision_heals_promoted_shard(tmp_path,
+                                                      telemetry_enabled):
+    """Day-2 self-repair (ISSUE 20): after a promotion leaves a shard one
+    replica short forever, a router configured with a
+    ``replica_provisioner`` detects the dead replica, provisions a fresh
+    empty server, has the promoted primary adopt it (bounded snapshot
+    resync) and swaps it into the replica set — no human in the loop."""
+    import time as _time
+
+    from orion_tpu.core.experiment import experiment_id
+
+    registry = telemetry_enabled
+    topo = SoakTopology(n_shards=2, replicas=2, persist_dir=str(tmp_path))
+    provisioner = ReplicaProvisioner()
+    router = topo.make_router(
+        replica_reads=False,
+        replica_provisioner=provisioner,
+        reprovision_after=0.5,
+        promote_after=0.3,
+    )
+    try:
+        eid = experiment_id("repro-0", 1, "soak")
+        victim = router.shard_for(eid)
+        router.write(
+            "experiments",
+            {"_id": eid, "name": "repro-0", "version": 1,
+             "metadata": {"user": "soak"}},
+        )
+        topo.shards[victim].wait_replicated()
+        # The one-short-forever state: a replica dies AND the primary dies
+        # for good; the election heals the primary, reprovisioning must
+        # heal the replica set.
+        topo.shards[victim].kill_replica(0)
+        topo.shards[victim].kill_primary(wait_catchup=False)
+        deadline = _time.monotonic() + 30.0
+        n = 0
+        while _time.monotonic() < deadline and router.promotions < 1:
+            n += 1
+            try:
+                router.write(
+                    "trials",
+                    {"_id": f"{eid}-t{n}", "experiment": eid,
+                     "status": "new", "params": {"/x": float(n)}},
+                )
+            except Exception:
+                _time.sleep(0.05)
+        assert router.promotions >= 1, "election never healed the primary"
+        while _time.monotonic() < deadline and router.reprovisions < 1:
+            _time.sleep(0.1)
+        assert router.reprovisions >= 1, "dead replica never reprovisioned"
+        assert registry.counter_value("storage.shard.reprovisions") >= 1
+        assert provisioner.servers, "the provisioner was never asked"
+        # The adopted replica converges and the shard reports full health.
+        def healed():
+            for entry in router.replication_health():
+                if entry["index"] != victim or entry.get("error"):
+                    continue
+                rows = entry.get("replicas", [])
+                if rows and all(not r.get("error") for r in rows):
+                    return True
+            return False
+
+        while _time.monotonic() < deadline and not healed():
+            _time.sleep(0.1)
+        assert healed(), router.replication_health()
+        assert (
+            registry.gauge_value("storage.reprovision.in_progress", 0.0)
+            == 0.0
+        )
+    finally:
+        router.close()
+        topo.stop()
+        provisioner.stop()
 
 
 @pytest.mark.chaos

@@ -200,6 +200,41 @@ def test_bench_smoke_emits_valid_json_with_breakdown_keys(tmp_path, repo_root):
     assert rebalance["rebalance"]["executed"] is True
     assert rebalance["rebalance"]["planned"]["moves"] >= 1
     assert sum(rebalance["completed_per_shard"].values()) == rebalance["completed"]
+    # The drain-mid-soak leg (ISSUE 20): the busiest shard was drained and
+    # REMOVED mid-run — zero residual, ~its ring share of the experiments
+    # moved (2x bound), zero lost observations, audits clean; bench.py
+    # hard-asserts (SystemExit) each of these before emitting.
+    drain = payload["drain_soak"]
+    assert drain["lost_observations"] == 0
+    assert drain["audits_clean"] is True
+    drained = drain["drain"]
+    assert drained["executed"] is True
+    assert drained["residual"] == 0
+    assert drained["planned"]["moves"] >= 1
+    assert drained["planned"]["move_fraction"] <= 2.0 * drained["ring_share"]
+    assert sum(drain["completed_per_shard"].values()) == drain["completed"]
+    # The quorum leg (ISSUE 20): quorum=1 writes, busiest primary killed
+    # with NO replication catch-up wait — the ack floor alone is the
+    # zero-loss mechanism.
+    quorum = payload["quorum_soak"]
+    assert quorum["lost_observations"] == 0
+    assert quorum["audits_clean"] is True
+    assert quorum["primary_kills"] >= 1
+    assert quorum["promotions"] >= 1
+    assert quorum["quorum"] == 1
+    assert quorum["wait_catchup"] is False
+    # The record-building pin: the BENCH_history columns for the two new
+    # legs must come out non-null from THIS payload (`is not None`, not
+    # truthiness — a quorum run losing zero observations is the point).
+    sys.path.insert(0, repo_root)
+    try:
+        from bench import bench_history_record
+    finally:
+        sys.path.remove(repo_root)
+    record = bench_history_record(payload)
+    assert record["soak_drained_frac"] is not None
+    assert record["soak_quorum_lost"] is not None
+    assert record["soak_quorum_lost"] == 0
     assert serve["per_tenant"] and all(
         row["p99_ms"] > 0 for row in serve["per_tenant"].values()
     )

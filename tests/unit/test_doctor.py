@@ -285,6 +285,57 @@ FIXTURES = {
         ),
         Snapshot(replication=_replication([0, 0]), now=NOW),
     ),
+    # Day-2 storage operations (ISSUE 20).  DX060: a drain phase stalled
+    # for minutes (fenced experiments refuse writes the whole time).
+    "DX060": (
+        Snapshot(
+            metrics=_metrics(gauges={"storage.drain.phase_age_s": 300.0}),
+            now=NOW,
+        ),
+        # A drain mid-flight moments after its last move is healthy.
+        Snapshot(
+            metrics=_metrics(gauges={"storage.drain.phase_age_s": 5.0}),
+            now=NOW,
+        ),
+    ),
+    # DX061: a promoted (epoch 1) primary one replica short, nothing being
+    # reprovisioned.  The quiet twin is the SAME short set with a repair
+    # in flight — the rule must hold its fire while the gauge is up.
+    "DX061": (
+        Snapshot(
+            replication=[
+                {
+                    "index": 0,
+                    "primary": "h:7010",
+                    "epoch": 1,
+                    "max_lag": 0,
+                    "replicas": [
+                        {"address": "h:7100", "error": "ConnectionRefusedError"},
+                        {"address": "h:7101", "seq": 5, "lag": 0},
+                    ],
+                }
+            ],
+            now=NOW,
+        ),
+        Snapshot(
+            metrics=_metrics(
+                gauges={"storage.reprovision.in_progress": 1.0}
+            ),
+            replication=[
+                {
+                    "index": 0,
+                    "primary": "h:7010",
+                    "epoch": 1,
+                    "max_lag": 0,
+                    "replicas": [
+                        {"address": "h:7100", "error": "ConnectionRefusedError"},
+                        {"address": "h:7101", "seq": 5, "lag": 0},
+                    ],
+                }
+            ],
+            now=NOW,
+        ),
+    ),
     "DX040": (
         Snapshot(health=_health(3, gp_mll=float("nan"), best_y=0.5), now=NOW),
         Snapshot(

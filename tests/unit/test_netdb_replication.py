@@ -230,3 +230,170 @@ def test_concurrent_writers_replicate_deterministically(pair):
     for client in clients:
         client.close()
     reader.close()
+
+
+# --- quorum mode (storage.quorum) + replica adoption (ISSUE 20) --------------
+
+
+@pytest.fixture
+def telemetry_enabled():
+    from orion_tpu.telemetry import TELEMETRY
+
+    was = TELEMETRY.enabled
+    TELEMETRY.enable()
+    yield TELEMETRY
+    if not was:
+        TELEMETRY.disable()
+
+
+def test_quorum_write_waits_for_replica_ack(telemetry_enabled):
+    """With ``quorum=1`` and a live replica, a SYNC-collection write
+    blocks until the replica's ack — by the time the reply lands, the
+    replica already HOLDS the write (no convergence wait), and the wait
+    is booked in the ``storage.quorum.wait`` histogram."""
+    replica = DBServer(port=0, replica=True)
+    replica.serve_background()
+    primary = DBServer(port=0, replicate_to=[replica.address], quorum=1)
+    primary.serve_background()
+    writer = _client(primary)
+    try:
+        writer.write("trials", {"_id": "t1", "experiment": "e", "v": 1})
+        # NO _wait_for: the quorum gate already guaranteed delivery.
+        assert replica.seq_info()["seq"] == 1
+        reader = _client(replica)
+        assert reader.read("trials", {"_id": "t1"})[0]["v"] == 1
+        reader.close()
+        assert primary.seq_info()["quorum"] == 1  # rides the probe
+        hist = telemetry_enabled.snapshot()["histograms"].get(
+            "storage.quorum.wait"
+        )
+        assert hist is not None and hist["count"] >= 1
+    finally:
+        writer.close()
+        for server in (primary, replica):
+            server.shutdown()
+            server.server_close()
+
+
+def test_quorum_timeout_raises_maybe_applied_and_async_stays_open(
+    telemetry_enabled,
+):
+    """A quorum write whose replica never acks fails ``maybe_applied``
+    (the write DID apply locally) and is TRANSIENT for the retry
+    classifier; async collections (telemetry) never gate on the floor."""
+    from orion_tpu.storage.retry import is_transient
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    # A replica that accepts connections but never replicates: a plain
+    # replica server the primary is NOT configured to push to would ack —
+    # so point the primary at a port nothing listens on.
+    probe = DBServer(port=0)
+    dead_addr = probe.address
+    probe.server_close()  # free the port; the pusher dials a void
+    primary = DBServer(
+        port=0, replicate_to=[dead_addr], quorum=1, quorum_timeout=0.3
+    )
+    primary.serve_background()
+    writer = _client(primary, timeout=5.0)
+    try:
+        with pytest.raises(DatabaseError) as err:
+            writer.write("trials", {"_id": "t1", "experiment": "e"})
+        assert getattr(err.value, "maybe_applied", False) is True
+        assert is_transient(err.value), "quorum timeout must be retriable"
+        assert "quorum" in str(err.value)
+        # The write applied locally — exactly what maybe_applied promises.
+        assert len(writer.read("trials", {"_id": "t1"})) == 1
+        assert (
+            telemetry_enabled.counter_value("storage.quorum.timeouts") >= 1
+        )
+        # Telemetry is async by contract: same dead replica, no gate.
+        writer.write("telemetry", {"_id": "m1", "experiment": "e"})
+    finally:
+        writer.close()
+        primary.shutdown()
+        primary.server_close()
+
+
+def test_retry_modes_split_on_quorum_timeout(telemetry_enabled):
+    """The classifier pin the drain/soak paths stand on: MODE_ALWAYS
+    retries a quorum timeout (convergent ops ride their duplicate-key
+    discipline), MODE_UNAPPLIED gives up at once (non-convergent ops must
+    not double-apply a write that may already be in)."""
+    from orion_tpu.storage.retry import (
+        MODE_ALWAYS,
+        MODE_UNAPPLIED,
+        RetryPolicy,
+    )
+    from orion_tpu.utils.exceptions import DatabaseError
+
+    probe = DBServer(port=0)
+    dead_addr = probe.address
+    probe.server_close()
+    primary = DBServer(
+        port=0, replicate_to=[dead_addr], quorum=1, quorum_timeout=0.1
+    )
+    primary.serve_background()
+    writer = _client(primary, timeout=5.0)
+    calls = {"n": 0}
+
+    def quorum_write():
+        calls["n"] += 1
+        writer.write("trials", {"_id": f"t{calls['n']}", "experiment": "e"})
+
+    try:
+        policy = RetryPolicy(max_attempts=3, base_delay=0.01, max_delay=0.02)
+        with pytest.raises(DatabaseError):
+            policy.run(quorum_write, op="test.quorum", mode=MODE_ALWAYS)
+        assert calls["n"] == 3, "MODE_ALWAYS must burn every attempt"
+        calls["n"] = 0
+        with pytest.raises(DatabaseError):
+            policy.run(quorum_write, op="test.quorum", mode=MODE_UNAPPLIED)
+        assert calls["n"] == 1, "MODE_UNAPPLIED must give up immediately"
+    finally:
+        writer.close()
+        primary.shutdown()
+        primary.server_close()
+
+
+def test_adopt_replica_is_idempotent_and_replicas_refuse():
+    """The wire op auto-reprovisioning drives: adopting a fresh empty
+    server starts the push (snapshot resync through the ordinary gap
+    logic), re-adopting reports ``existing``, and a REPLICA refuses —
+    only the shard's current primary owns the fan-out.  The primary here
+    already replicates (to a surviving replica), exactly the post-
+    promotion one-short state reprovisioning repairs."""
+    survivor = DBServer(port=0, replica=True)
+    survivor.serve_background()
+    primary = DBServer(port=0, replicate_to=[survivor.address])
+    primary.serve_background()
+    writer = _client(primary)
+    for i in range(4):
+        writer.write("trials", {"_id": f"t{i}", "experiment": "e"})
+    fresh = DBServer(port=0, replica=True)
+    fresh.serve_background()
+    addr = "%s:%s" % fresh.address
+    try:
+        result = primary.handle_adopt_replica({"address": addr})
+        assert result == {"adopted": True, "existing": False, "epoch": 1}
+        again = primary.handle_adopt_replica({"address": addr})
+        assert again["adopted"] and again["existing"]
+        # The pre-adoption history snapshot-resyncs to the adoptee.
+        reader = _client(fresh)
+        _wait_for(
+            lambda: len(reader.read("trials", {"experiment": "e"})) == 4,
+            message="adopted replica never converged",
+        )
+        writer.write("trials", {"_id": "t-after", "experiment": "e"})
+        _wait_for(
+            lambda: len(reader.read("trials", {"experiment": "e"})) == 5,
+            message="post-adoption stream never flowed",
+        )
+        reader.close()
+        # A replica refuses adoption outright.
+        refused = fresh.handle_adopt_replica({"address": "127.0.0.1:1"})
+        assert refused["adopted"] is False
+    finally:
+        writer.close()
+        for server in (primary, fresh, survivor):
+            server.shutdown()
+            server.server_close()

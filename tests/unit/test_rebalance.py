@@ -119,15 +119,20 @@ def _exp_ids():
 
 
 def _snapshot_docs(router):
-    """Canonical doc map for byte-identity comparison across a move."""
+    """Canonical doc map for byte-identity comparison across a move.
+    Telemetry is an auto-id channel: it moves by experiment-scoped
+    content (the destination assigns its own ``_id``), so it snapshots
+    as a per-experiment content multiset instead of by id."""
     by_id = {}
     for eid in _exp_ids():
         for doc in router.read("trials", {"experiment": eid}):
             by_id[doc["_id"]] = dumps_canonical(doc)
         for doc in router.read("experiments", {"_id": eid}):
             by_id[doc["_id"]] = dumps_canonical(doc)
-        for doc in router.read("telemetry", {"experiment": eid}):
-            by_id[doc["_id"]] = dumps_canonical(doc)
+        by_id[f"telemetry:{eid}"] = sorted(
+            dumps_canonical({k: v for k, v in doc.items() if k != "_id"})
+            for doc in router.read("telemetry", {"experiment": eid})
+        )
     return by_id
 
 
